@@ -1,0 +1,28 @@
+"""fuzzyheavyhitters_tpu — a TPU-native two-server private fuzzy heavy hitters framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of the reference
+``sks-codes/fuzzyheavyhitters`` system (two-server interval-bound DCF fuzzy
+heavy hitters, IEEE S&P 2021 lineage).  Nothing here is a port: keys are
+tensors, tree frontiers are tensors, the two collector servers are two devices
+on a mesh axis, and the server<->server exchange is an XLA collective.
+
+Layout (mirrors the reference's layer map, SURVEY.md §1):
+
+- ``utils``     bit codecs, bitstring arithmetic, config    (ref: src/lib.rs, src/config.rs)
+- ``ops``       PRG, prime fields, ibDCF keygen/eval, 2PC   (ref: src/prg.rs, src/fastfield.rs,
+                                                             src/field.rs, src/ibDCF.rs,
+                                                             src/equalitytest.rs)
+- ``parallel``  device mesh + server/client-axis collectives (ref: src/bin/server.rs TCP mesh)
+- ``models``    the aggregation engine / protocol state machine (ref: src/collect.rs)
+- ``protocol``  leader/server processes + 8-verb RPC         (ref: src/rpc.rs, src/bin/*.rs)
+- ``workloads`` zipf / rides / covid samplers + CSV output   (ref: src/sample_*.rs)
+
+64-bit integer support is required for the fast 62-bit field (``ops.field62``);
+we enable it here, before any JAX arrays are created.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,2 @@
+from . import bits  # noqa: F401
+from .config import Config, load_config  # noqa: F401

@@ -1,0 +1,65 @@
+"""Config system — JSON schema compatible with the reference's config files.
+
+The schema mirrors ``Config`` in the reference (ref: src/config.rs:5-16):
+``data_len, n_dims, ball_size, addkey_batch_size, num_sites, threshold,
+zipf_exponent, server0, server1, distribution``.  The reference's shipped
+JSON files also carry ``sketch_batch_size`` / ``sketch_batch_size_last`` keys
+that its parser ignores (config.rs vs src/bin/config.json:9-10); we parse them
+(the resurrected malicious-secure sketch uses them) with the shipped defaults.
+
+Extra TPU-native knobs (all defaulted so reference configs load unchanged):
+
+- ``backend``: "tpu" | "cpu" — device for server-side aggregation.
+- ``secure_exchange``: if True, use the GC+OT 2PC data plane; if False, the
+  trusted-exchange mode that reveals per-(node,client) equality bits between
+  the two servers (counts are still additively shared toward the leader).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+
+
+@dataclasses.dataclass
+class Config:
+    data_len: int
+    n_dims: int
+    ball_size: int
+    addkey_batch_size: int
+    num_sites: int
+    threshold: float
+    zipf_exponent: float
+    server0: str
+    server1: str
+    distribution: str
+    sketch_batch_size: int = 100_000
+    sketch_batch_size_last: int = 25_000
+    backend: str = "tpu"
+    secure_exchange: bool = False
+
+
+def load_config(path: str) -> Config:
+    with open(path) as f:
+        raw = json.load(f)
+    fields = {f.name for f in dataclasses.fields(Config)}
+    unknown = set(raw) - fields
+    if unknown:
+        raise ValueError(f"Unknown config keys: {sorted(unknown)}")
+    return Config(**raw)
+
+
+def get_args(name: str, get_server_id: bool = False, get_n_reqs: bool = False):
+    """CLI mirroring the reference's flags (ref: src/config.rs:55-111)."""
+    p = argparse.ArgumentParser(prog=name, description="TPU-native private fuzzy heavy hitters.")
+    p.add_argument("-c", "--config", required=True, help="Location of JSON config file")
+    if get_server_id:
+        p.add_argument("-i", "--server_id", type=int, required=True, help="Zero-indexed ID of server")
+    if get_n_reqs:
+        p.add_argument("-n", "--num_requests", type=int, required=True, help="Number of client requests")
+    args = p.parse_args()
+    cfg = load_config(args.config)
+    server_id = getattr(args, "server_id", -1)
+    n_reqs = getattr(args, "num_requests", 0)
+    return cfg, server_id, n_reqs

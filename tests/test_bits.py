@@ -1,0 +1,53 @@
+"""Bit codec tests (ref semantics: src/lib.rs:191-239, sample_driving_data.rs:149-163)."""
+
+import numpy as np
+
+from fuzzyheavyhitters_tpu.utils import bits as B
+
+
+def test_u32_roundtrip():
+    assert list(B.u32_to_bits(0, 7)) == []
+    assert list(B.u32_to_bits(2, 3)) == [True, True]
+    assert list(B.u32_to_bits(2, 1)) == [True, False]
+    assert B.bits_to_u32(B.msb_u32_to_bits(12, 1234)) == 1234
+
+
+def test_string_roundtrip():
+    s = "basfsdfwefwf"
+    b = B.string_to_bits(s)
+    assert b.size == len(s) * 8
+    assert B.bits_to_string(b) == s
+    assert list(B.string_to_bits("a")) == [True, False, False, False, False, True, True, False]
+
+
+def test_all_bit_vectors_ordering():
+    v = B.all_bit_vectors(2)
+    assert v.shape == (4, 2)
+    # pattern i has bit j = (i >> j) & 1  (lib.rs:125-129)
+    assert [list(r) for r in v] == [
+        [False, False],
+        [True, False],
+        [False, True],
+        [True, True],
+    ]
+
+
+def test_bitstring_arithmetic_saturates():
+    a = B.msb_u32_to_bits(8, 200)
+    assert B.bits_to_u32(B.add_bitstrings(a, B.msb_u32_to_bits(8, 10))) == 210
+    assert B.bits_to_u32(B.add_bitstrings(a, B.msb_u32_to_bits(8, 100))) == 255
+    assert B.bits_to_u32(B.subtract_bitstrings(a, B.msb_u32_to_bits(8, 10))) == 190
+    assert B.bits_to_u32(B.subtract_bitstrings(B.msb_u32_to_bits(8, 10), a)) == 0
+    # width promotion: delta wider than alpha (ibDCF.rs:178 uses 32-bit delta)
+    assert B.bits_to_u32(B.subtract_bitstrings(B.msb_u32_to_bits(8, 9), B.msb_u32_to_bits(32, 4))) == 5
+
+
+def test_i16_bitvec_roundtrip():
+    for v in [0, 1, -1, 3026, -9774, 32767, -32768]:
+        assert B.bitvec_to_i16(B.i16_to_bitvec(v)) == v
+
+
+def test_pack_bits_lsb():
+    arr = np.array([[True, False, True], [False, True, True]])
+    packed = B.pack_bits_lsb(arr)
+    assert list(packed) == [0b101, 0b110]

@@ -1,0 +1,117 @@
+"""Semantic property tests for the pure-Python spec oracle.
+
+These are the corrected, property-test versions of the reference's FSS unit
+suite (ref: tests/ibdcf_tests.rs — whose own asserts mix bit orders; see
+tests/oracle.py docstring).  Everything here uses MSB-first encodings, the
+encoding the live protocol actually uses.
+"""
+
+import numpy as np
+import pytest
+
+import oracle
+from oracle import (
+    eval_prefix,
+    gen_ibdcf,
+    gen_interval,
+    share_bit,
+)
+
+
+def msb_bits(nbits, v):
+    return [(v >> i) & 1 == 1 for i in reversed(range(nbits))]
+
+
+@pytest.fixture(params=[False, True], ids=["masked-bits", "derived-bits"])
+def bits_mode(request, monkeypatch):
+    monkeypatch.setattr(oracle, "DERIVED_BITS", request.param)
+    return request.param
+
+
+def test_single_dcf_full_domain(rng, bits_mode):
+    """Exhaustive 5-bit sweep: share-XOR == strict comparison at full length
+    (corrected form of ibdcf_tests.rs:4-39)."""
+    nbits = 5
+    for alpha in [0, 1, 10, 21, 30, 31]:
+        for side in (False, True):
+            k0, k1 = gen_ibdcf(msb_bits(nbits, alpha), side, rng)
+            for x in range(1 << nbits):
+                s0 = eval_prefix(k0, msb_bits(nbits, x))
+                s1 = eval_prefix(k1, msb_bits(nbits, x))
+                got = share_bit(s0) ^ share_bit(s1)
+                want = (x < alpha) if side else (x > alpha)
+                assert got == want, (alpha, side, x)
+
+
+def test_t_bit_marks_alpha_path(rng, bits_mode):
+    nbits = 5
+    alpha = 19
+    k0, k1 = gen_ibdcf(msb_bits(nbits, alpha), False, rng)
+    for x in range(1 << nbits):
+        s0 = eval_prefix(k0, msb_bits(nbits, x))
+        s1 = eval_prefix(k1, msb_bits(nbits, x))
+        assert (s0.bit ^ s1.bit) == (x == alpha)
+
+
+def test_prefix_semantics(rng, bits_mode):
+    """At prefix length j the comparison is against the bound's j-bit prefix."""
+    nbits = 5
+    alpha = 21
+    for side in (False, True):
+        k0, k1 = gen_ibdcf(msb_bits(nbits, alpha), side, rng)
+        for x in range(1 << nbits):
+            xb = msb_bits(nbits, x)
+            for j in range(1, nbits + 1):
+                s0 = eval_prefix(k0, xb[:j])
+                s1 = eval_prefix(k1, xb[:j])
+                got = share_bit(s0) ^ share_bit(s1)
+                a_pre, x_pre = alpha >> (nbits - j), x >> (nbits - j)
+                want = (x_pre < a_pre) if side else (x_pre > a_pre)
+                assert got == want, (side, x, j)
+
+
+def test_interval_membership(rng, bits_mode):
+    """Share-string equality <=> inclusive interval membership
+    (corrected form of ibdcf_tests.rs:294-356, incl. single-point,
+    full-range, and edge intervals)."""
+    nbits = 5
+    cases = [(5, 10), (8, 8), (0, 31), (0, 0), (31, 31), (13, 22)]
+    for left, right in cases:
+        keys0, keys1 = gen_interval(msb_bits(nbits, left), msb_bits(nbits, right), rng)
+        for x in range(1 << nbits):
+            xb = msb_bits(nbits, x)
+            str0 = [share_bit(eval_prefix(k, xb)) for k in keys0]
+            str1 = [share_bit(eval_prefix(k, xb)) for k in keys1]
+            inside = left <= x <= right
+            assert (str0 == str1) == inside, (left, right, x)
+
+
+def test_interval_prefix_membership_is_box_intersection(rng, bits_mode):
+    """At level j, equality of share strings == [ball intersects prefix box]."""
+    nbits = 5
+    left, right = 6, 20
+    keys0, keys1 = gen_interval(msb_bits(nbits, left), msb_bits(nbits, right), rng)
+    for j in range(1, nbits + 1):
+        for p in range(1 << j):
+            pb = msb_bits(j, p)
+            str0 = [share_bit(eval_prefix(k, pb)) for k in keys0]
+            str1 = [share_bit(eval_prefix(k, pb)) for k in keys1]
+            box_lo = p << (nbits - j)
+            box_hi = box_lo + (1 << (nbits - j)) - 1
+            intersects = not (box_hi < left or box_lo > right)
+            assert (str0 == str1) == intersects, (j, p)
+
+
+def test_incremental_matches_full(rng, bits_mode):
+    """Incremental one-bit eval state equals from-scratch prefix eval
+    (real-assert form of ibdcf_tests.rs:92-153)."""
+    nbits = 6
+    alpha = 37
+    k0, _ = gen_ibdcf(msb_bits(nbits, alpha), True, rng)
+    for x in [0, 5, 37, 63]:
+        xb = msb_bits(nbits, x)
+        state = oracle.eval_init(k0)
+        for j, b in enumerate(xb):
+            state = oracle.eval_bit(k0, state, bool(b))
+            full = eval_prefix(k0, xb[: j + 1])
+            assert (state.seed, state.bit, state.y_bit) == (full.seed, full.bit, full.y_bit)
