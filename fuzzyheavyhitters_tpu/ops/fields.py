@@ -1,0 +1,294 @@
+"""Prime fields as JAX dtype modules.
+
+Two fields, mirroring the reference's dual-field design (inner tree levels in
+a fast 62-bit field, final level in a 255-bit field):
+
+- ``FE62``: p = 2^62 - 2^30 - 1 on ``uint64`` tensors with the same lazy
+  bit-reduction representation as the reference (ref: src/fastfield.rs:24-107)
+  — shifts and masks only, no division, XLA/TPU-friendly.
+- ``F255``: p = 2^255 - 19 on ``uint32[..., 8]`` little-endian limb tensors
+  (ref: src/field.rs:19 — its comment says 2^255-10 but the hex constant
+  ``7fff...ffed`` is 2^255-19; we match the constant).  Values are kept
+  canonical (< p); ops are fixed 8-limb carry chains.
+
+Both expose the same functional surface (zeros/from_int/add/sub/neg/canon/
+ge/sample/pack...), so the aggregation engine is generic over the level
+field.  ``sample`` maps uniform random words to near-uniform field elements
+with O(2^-62) statistical bias — data-independent shapes (no rejection
+loops), unlike the reference's host-side rejection sampling
+(ref: src/field.rs:251-264), which cannot be expressed as a fixed-shape
+device program.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+_M62 = (1 << 62) - 1
+_P62 = (1 << 62) - (1 << 30) - 1
+
+
+class FE62:
+    """p = 2^62 - 2^30 - 1 over uint64, lazily reduced (val <= ~2^62)."""
+
+    P = _P62
+    dtype = jnp.uint64
+    limb_shape = ()  # scalar per element
+
+    @staticmethod
+    def _bit_reduce(v):
+        # 2^62 === 2^30 + 1 (mod p)   (fastfield.rs:86-95)
+        excess = v >> 62
+        low = v & jnp.uint64(_M62)
+        return low + excess + (excess << 30)
+
+    @classmethod
+    def new(cls, v):
+        return cls._bit_reduce(jnp.asarray(v, jnp.uint64))
+
+    @classmethod
+    def zeros(cls, shape):
+        return jnp.zeros(shape, jnp.uint64)
+
+    @classmethod
+    def from_int(cls, x: int):
+        return jnp.asarray(x % cls.P, jnp.uint64)
+
+    @classmethod
+    def canon(cls, v):
+        """Fully-reduced value in [0, p)  (fastfield.rs:100-107, 147-152)."""
+        v = cls._bit_reduce(cls._bit_reduce(v))
+        return jnp.where(v >= cls.P, v - cls.P, v)
+
+    @classmethod
+    def add(cls, a, b):
+        return cls._bit_reduce(a + b)
+
+    @classmethod
+    def neg(cls, a):
+        return cls._bit_reduce(jnp.uint64(2 * cls.P) - a)
+
+    @classmethod
+    def sub(cls, a, b):
+        return cls.add(a, cls.neg(b))
+
+    @classmethod
+    def mul(cls, a, b):
+        """Full 124-bit product reduced mod p, u64 ops only."""
+        a = cls._bit_reduce(cls._bit_reduce(a))  # < 2^62
+        b = cls._bit_reduce(cls._bit_reduce(b))
+        mask32 = jnp.uint64(0xFFFFFFFF)
+        a0, a1 = a & mask32, a >> 32  # a1 < 2^30
+        b0, b1 = b & mask32, b >> 32
+        t0 = a0 * b0
+        t1 = a0 * b1 + a1 * b0  # < 2^63
+        t2 = a1 * b1  # < 2^60
+        t1 = t1 + (t0 >> 32)
+        c0 = t0 & mask32
+        t2 = t2 + (t1 >> 32)  # < 2^61
+        c1 = t1 & mask32
+        # product = c0 + c1*2^32 + t2*2^64 ; split at bit 62
+        low = ((c1 & jnp.uint64(0x3FFFFFFF)) << 32) | c0
+        high = (t2 << 2) | (c1 >> 30)
+        # product === low + high*(2^30 + 1) (mod p); split high to keep u64
+        h0, h1 = high & mask32, high >> 32
+        r = cls._bit_reduce(low + high)
+        r = cls._bit_reduce(r + (h0 << 30))
+        r = cls._bit_reduce(r + (h1 << 30))
+        return cls._bit_reduce(r + h1)
+
+    @classmethod
+    def ge(cls, a, b):
+        return cls.canon(a) >= cls.canon(b)
+
+    @classmethod
+    def eq(cls, a, b):
+        return cls.canon(a) == cls.canon(b)
+
+    @classmethod
+    def sample(cls, words):
+        """uniform uint32[..., 4] -> near-uniform field elements [...]."""
+        words = jnp.asarray(words, jnp.uint64)
+        lo = (words[..., 0] | (words[..., 1] << 32)) & jnp.uint64(_M62)
+        hi = words[..., 2] | (words[..., 3] << 32)
+        # value = hi*2^62 + lo (mod p): 126 uniform bits -> bias ~2^-64
+        mask32 = jnp.uint64(0xFFFFFFFF)
+        h0, h1 = hi & mask32, hi >> 32
+        r = cls._bit_reduce(lo + hi)
+        r = cls._bit_reduce(r + (h0 << 30))
+        r = cls._bit_reduce(r + (h1 << 30))
+        return cls._bit_reduce(r + h1)
+
+    @classmethod
+    def sum(cls, v, *, axis):
+        """Modular sum along ``axis`` for up to ~2^31 canonical terms.
+
+        Splits into 32-bit halves so the plain integer sums cannot overflow
+        u64, then recombines mod p — one reduction for the whole axis instead
+        of the reference's per-element add chain (collect.rs:487-501).
+        """
+        v = cls._bit_reduce(cls._bit_reduce(v))  # < 2^62
+        mask32 = jnp.uint64(0xFFFFFFFF)
+        lo = jnp.sum(v & mask32, axis=axis)
+        hi = jnp.sum(v >> 32, axis=axis)
+        return cls.add(cls._bit_reduce(lo), cls.mul(cls.new(hi), cls.from_int(1 << 32)))
+
+    @classmethod
+    def to_numpy_ints(cls, v) -> np.ndarray:
+        return np.asarray(jax.jit(cls.canon)(v), dtype=np.uint64)
+
+
+_P255 = (1 << 255) - 19
+_P255_LIMBS = tuple((_P255 >> (32 * i)) & 0xFFFFFFFF for i in range(8))
+
+
+class F255:
+    """p = 2^255 - 19 over uint32[..., 8] little-endian limbs, canonical."""
+
+    P = _P255
+    dtype = jnp.uint32
+    limb_shape = (8,)
+
+    @classmethod
+    def zeros(cls, shape):
+        return jnp.zeros(tuple(shape) + (8,), jnp.uint32)
+
+    @classmethod
+    def from_int(cls, x: int):
+        x %= cls.P
+        return jnp.array([(x >> (32 * i)) & 0xFFFFFFFF for i in range(8)], jnp.uint32)
+
+    @staticmethod
+    def _carry_chain(limbs64):
+        """[..., 8] uint64 partial sums -> (uint32 limbs, carry_out uint64)."""
+        out = []
+        carry = jnp.zeros_like(limbs64[..., 0])
+        for i in range(8):
+            s = limbs64[..., i] + carry
+            out.append(s & jnp.uint64(0xFFFFFFFF))
+            carry = s >> 32
+        return jnp.stack(out, axis=-1), carry
+
+    @classmethod
+    def _sub_p_if(cls, limbs, cond):
+        """Conditionally subtract p (borrow chain); cond broadcast over limbs."""
+        p = jnp.array(_P255_LIMBS, jnp.uint64)
+        out = []
+        borrow = jnp.zeros_like(limbs[..., 0].astype(jnp.uint64))
+        for i in range(8):
+            d = limbs[..., i].astype(jnp.uint64) - p[i] - borrow
+            out.append(d & jnp.uint64(0xFFFFFFFF))
+            borrow = (d >> 63) & jnp.uint64(1)  # underflow wraps high bit
+        sub = jnp.stack(out, axis=-1).astype(jnp.uint32)
+        return jnp.where(cond[..., None], sub, limbs)
+
+    @classmethod
+    def _geq_p(cls, limbs):
+        ge = jnp.ones(limbs.shape[:-1], bool)
+        decided = jnp.zeros(limbs.shape[:-1], bool)
+        for i in reversed(range(8)):
+            li = limbs[..., i]
+            pi = jnp.uint32(_P255_LIMBS[i])
+            gt = ~decided & (li > pi)
+            lt = ~decided & (li < pi)
+            ge = jnp.where(lt, False, jnp.where(gt, True, ge))
+            decided = decided | gt | lt
+        return ge
+
+    @classmethod
+    def add(cls, a, b):
+        s64 = a.astype(jnp.uint64) + b.astype(jnp.uint64)
+        limbs, carry = cls._carry_chain(s64)
+        # carry*2^256 === carry*38 (mod p); carry <= 1 so one more chain settles
+        limbs = cls._carry_chain(limbs.astype(jnp.uint64).at[..., 0].add(carry * 38))[0]
+        limbs = limbs.astype(jnp.uint32)
+        return cls._sub_p_if(limbs, cls._geq_p(limbs))
+
+    @classmethod
+    def neg(cls, a):
+        p = jnp.array(_P255_LIMBS, jnp.uint64)
+        out = []
+        borrow = jnp.zeros_like(a[..., 0].astype(jnp.uint64))
+        for i in range(8):
+            d = p[i] - a[..., i].astype(jnp.uint64) - borrow
+            out.append(d & jnp.uint64(0xFFFFFFFF))
+            borrow = (d >> 63) & jnp.uint64(1)
+        r = jnp.stack(out, axis=-1).astype(jnp.uint32)
+        # p - 0 = p === 0: canonicalize
+        return cls._sub_p_if(r, cls._geq_p(r))
+
+    @classmethod
+    def sub(cls, a, b):
+        return cls.add(a, cls.neg(b))
+
+    @classmethod
+    def canon(cls, a):
+        return a
+
+    @classmethod
+    def ge(cls, a, b):
+        """a >= b on canonical values, limbwise big-endian compare."""
+        ge = jnp.ones(a.shape[:-1], bool)
+        decided = jnp.zeros(a.shape[:-1], bool)
+        for i in reversed(range(8)):
+            gt = ~decided & (a[..., i] > b[..., i])
+            lt = ~decided & (a[..., i] < b[..., i])
+            ge = jnp.where(lt, False, jnp.where(gt, True, ge))
+            decided = decided | gt | lt
+        return ge
+
+    @classmethod
+    def eq(cls, a, b):
+        return jnp.all(a == b, axis=-1)
+
+    @classmethod
+    def sample(cls, words):
+        """uniform uint32[..., 8] -> field elements [..., 8] (bias ~2^-250)."""
+        limbs = jnp.asarray(words, jnp.uint32)
+        limbs = cls._sub_p_if(limbs, cls._geq_p(limbs))
+        limbs = cls._sub_p_if(limbs, cls._geq_p(limbs))
+        return limbs
+
+    @classmethod
+    def sum(cls, v, *, axis):
+        """Modular sum along ``axis`` via pairwise tree reduction."""
+        axis = axis % (v.ndim - 1)
+        v = jnp.moveaxis(v, axis, 0)
+        while v.shape[0] > 1:
+            n = v.shape[0]
+            if n % 2:
+                v = jnp.concatenate([v, cls.zeros((1,) + v.shape[1:-1])], axis=0)
+                n += 1
+            v = cls.add(v[: n // 2], v[n // 2 :])
+        return v[0]
+
+    @classmethod
+    def to_numpy_ints(cls, v) -> np.ndarray:
+        limbs = np.asarray(v, dtype=np.uint64)
+        flat = limbs.reshape(-1, 8)
+        out = np.array(
+            [sum(int(row[i]) << (32 * i) for i in range(8)) for row in flat],
+            dtype=object,
+        )
+        return out.reshape(limbs.shape[:-1])
+
+
+def _jit_field_methods():
+    """Jit the eager entry points once per class; composing jitted calls inside
+    a larger jit still inlines and fuses (XLA treats them as nested calls)."""
+    for klass, names in (
+        (FE62, ["new", "canon", "add", "neg", "sub", "mul", "ge", "eq", "sample"]),
+        (F255, ["add", "neg", "sub", "ge", "eq", "sample"]),
+    ):
+        for name in names:
+            setattr(klass, name, staticmethod(jax.jit(getattr(klass, name))))
+        setattr(
+            klass,
+            "sum",
+            staticmethod(jax.jit(getattr(klass, "sum"), static_argnames=("axis",))),
+        )
+
+
+_jit_field_methods()

@@ -1,0 +1,124 @@
+"""Field law + edge-case tests vs exact Python integer arithmetic.
+
+Covers the reference's fastfield/field inline suites (ref:
+src/fastfield.rs:432-559, src/field.rs:495-623) as property tests.
+"""
+
+import numpy as np
+import pytest
+
+import fuzzyheavyhitters_tpu  # noqa: F401  (enables x64)
+import jax.numpy as jnp
+
+from fuzzyheavyhitters_tpu.ops.fields import FE62, F255
+
+P62 = FE62.P
+P255 = F255.P
+
+
+def _rand_ints(rng, n, bound):
+    return [int(rng.integers(0, min(bound, 2**63))) if bound < 2**63
+            else int.from_bytes(rng.bytes(32), "little") % bound
+            for _ in range(n)]
+
+
+EDGE62 = [0, 1, 2, (1 << 30), (1 << 30) + 1, (1 << 31), P62 - 1, P62 - 2, P62 // 2]
+
+
+def test_fe62_add_sub_neg_mul(rng):
+    xs = EDGE62 + [int(rng.integers(0, P62)) for _ in range(50)]
+    ys = list(reversed(xs))
+    a = FE62.new(jnp.array(xs, jnp.uint64))
+    b = FE62.new(jnp.array(ys, jnp.uint64))
+    got_add = FE62.to_numpy_ints(FE62.add(a, b))
+    got_sub = FE62.to_numpy_ints(FE62.sub(a, b))
+    got_neg = FE62.to_numpy_ints(FE62.neg(a))
+    got_mul = FE62.to_numpy_ints(FE62.mul(a, b))
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        assert got_add[i] == (x + y) % P62
+        assert got_sub[i] == (x - y) % P62
+        assert got_neg[i] == (-x) % P62
+        assert got_mul[i] == (x * y) % P62, (x, y)
+
+
+def test_fe62_new_accepts_any_u64(rng):
+    xs = [0, 1, (1 << 62), (1 << 62) + 5, 2**64 - 1, P62, P62 + 1]
+    got = FE62.to_numpy_ints(FE62.new(jnp.array(xs, jnp.uint64)))
+    for i, x in enumerate(xs):
+        assert got[i] == x % P62
+
+
+def test_fe62_compare():
+    a = FE62.new(jnp.array([5, P62 - 1, 7], jnp.uint64))
+    b = FE62.new(jnp.array([5, 3, 9], jnp.uint64))
+    assert list(np.asarray(FE62.ge(a, b))) == [True, True, False]
+
+
+def test_fe62_sum(rng):
+    xs = [int(rng.integers(0, P62)) for _ in range(1000)]
+    got = int(FE62.to_numpy_ints(FE62.sum(FE62.new(jnp.array(xs, jnp.uint64)), axis=0)))
+    assert got == sum(xs) % P62
+
+
+def test_fe62_sample_shape_and_spread(rng):
+    words = jnp.array(rng.integers(0, 2**32, size=(256, 4)), jnp.uint32)
+    v = FE62.sample(words)
+    vals = FE62.to_numpy_ints(v)
+    assert len(set(vals.tolist())) > 250  # no collisions expected
+    assert all(int(x) < P62 for x in vals)
+
+
+def _f255_from_ints(xs):
+    return jnp.stack([F255.from_int(x) for x in xs])
+
+
+EDGE255 = [0, 1, 19, 38, (1 << 255) - 20, P255 - 1, P255 // 2, (1 << 256) % P255]
+
+
+def test_f255_add_sub_neg(rng):
+    xs = EDGE255 + [int.from_bytes(rng.bytes(32), "little") % P255 for _ in range(30)]
+    ys = list(reversed(xs))
+    a, b = _f255_from_ints(xs), _f255_from_ints(ys)
+    got_add = F255.to_numpy_ints(F255.add(a, b))
+    got_sub = F255.to_numpy_ints(F255.sub(a, b))
+    got_neg = F255.to_numpy_ints(F255.neg(a))
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        assert int(got_add[i]) == (x + y) % P255
+        assert int(got_sub[i]) == (x - y) % P255
+        assert int(got_neg[i]) == (-x) % P255
+
+
+def test_f255_compare_and_eq():
+    a = _f255_from_ints([5, P255 - 1, 7, 1 << 200])
+    b = _f255_from_ints([5, 3, 9, (1 << 200) + 1])
+    assert list(np.asarray(F255.ge(a, b))) == [True, True, False, False]
+    assert list(np.asarray(F255.eq(a, b))) == [True, False, False, False]
+
+
+def test_f255_sum(rng):
+    xs = [int.from_bytes(rng.bytes(32), "little") % P255 for _ in range(33)]
+    got = F255.to_numpy_ints(F255.sum(_f255_from_ints(xs), axis=0))
+    assert int(got) == sum(xs) % P255
+
+
+def test_f255_sample(rng):
+    words = jnp.array(rng.integers(0, 2**32, size=(64, 8)), jnp.uint32)
+    vals = F255.to_numpy_ints(F255.sample(words))
+    assert all(int(x) < P255 for x in vals.ravel())
+
+
+def test_share_reconstruct_roundtrip(rng):
+    """share()/reconstruct semantics (ref: src/lib.rs:42-49): v = s1 - s0... the
+    reference reconstructs leader-side as vals0 - vals1 (collect.rs:945-964);
+    here: value v shared as (r + v, r)."""
+    for F, P in [(FE62, P62), (F255, P255)]:
+        v = 123456789 % P
+        r = int.from_bytes(rng.bytes(16), "little") % P
+        if F is FE62:
+            s0 = F.add(F.from_int(r), F.from_int(v))
+            s1 = F.from_int(r)
+        else:
+            s0 = F.add(F.from_int(r), F.from_int(v))
+            s1 = F.from_int(r)
+        rec = F.to_numpy_ints(F.sub(s0, s1))
+        assert int(rec) == v
