@@ -88,15 +88,18 @@ class EvalState:
     y_bit: bool
 
 
-def gen_ibdcf(alpha_bits, side: bool, rng: np.random.Generator) -> Tuple[IbDcfKey, IbDcfKey]:
+def gen_ibdcf(
+    alpha_bits, side: bool, rng: np.random.Generator, prg=None
+) -> Tuple[IbDcfKey, IbDcfKey]:
     """Keygen (ref: ibDCF.rs:84-119, 138-164)."""
+    prg = prg or prg_expand
     seeds = [rng.bytes(SEED_LEN), rng.bytes(SEED_LEN)]
     bits = [False, True]
     cor_words = []
     root = list(seeds)
     for bit in list(np.asarray(alpha_bits, dtype=bool)):
         bit = bool(bit)
-        data = [prg_expand(seeds[0]), prg_expand(seeds[1])]
+        data = [prg(seeds[0]), prg(seeds[1])]
         keep, lose = int(bit), int(not bit)
         cw = CorWord(
             seed=_xor(data[0][:2][lose], data[1][:2][lose]),
@@ -128,9 +131,9 @@ def eval_init(key: IbDcfKey) -> EvalState:
     return EvalState(0, key.root_seed, key.key_idx, key.key_idx)
 
 
-def eval_bit(key: IbDcfKey, state: EvalState, direction: bool) -> EvalState:
+def eval_bit(key: IbDcfKey, state: EvalState, direction: bool, prg=None) -> EvalState:
     """One-bit incremental eval (ref: ibDCF.rs:208-227)."""
-    s_l, s_r, tau_bits, tau_y = prg_expand(state.seed)
+    s_l, s_r, tau_bits, tau_y = (prg or prg_expand)(state.seed)
     d = int(direction)
     seed = (s_l, s_r)[d]
     new_bit = tau_bits[d]
@@ -144,10 +147,10 @@ def eval_bit(key: IbDcfKey, state: EvalState, direction: bool) -> EvalState:
     return EvalState(state.level + 1, seed, new_bit, new_y)
 
 
-def eval_prefix(key: IbDcfKey, idx) -> EvalState:
+def eval_prefix(key: IbDcfKey, idx, prg=None) -> EvalState:
     state = eval_init(key)
     for b in np.asarray(idx, dtype=bool):
-        state = eval_bit(key, state, bool(b))
+        state = eval_bit(key, state, bool(b), prg=prg)
     return state
 
 
@@ -156,9 +159,9 @@ def share_bit(state: EvalState) -> bool:
     return state.y_bit ^ state.bit
 
 
-def gen_interval(left_bits, right_bits, rng) -> Tuple[list, list]:
+def gen_interval(left_bits, right_bits, rng, prg=None) -> Tuple[list, list]:
     """(left-DCF side=True on left bound, right-DCF side=False on right bound);
     returns per-server pairs (ref: ibDCF.rs:166-173)."""
-    lk0, lk1 = gen_ibdcf(left_bits, True, rng)
-    rk0, rk1 = gen_ibdcf(right_bits, False, rng)
+    lk0, lk1 = gen_ibdcf(left_bits, True, rng, prg=prg)
+    rk0, rk1 = gen_ibdcf(right_bits, False, rng, prg=prg)
     return [lk0, rk0], [lk1, rk1]
