@@ -1,0 +1,59 @@
+"""Headline benchmark: ibDCF key generation throughput at data_len=512.
+
+Reference baseline: 99.97 µs/key single-threaded with AES-NI
+(≈10,003 keys/s; src/bin/benchmarks/ibDCFbench.csv:5, BASELINE.md), the
+north-star metric "client-keys/sec/chip at data_len=512".
+
+Prints ONE JSON line: value = keys/s on one chip, vs_baseline = speedup
+over the reference CPU number.
+"""
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_KEYS_PER_SEC = 1e6 / 99.97  # ibDCFbench.csv:5 (data_len=512)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from fuzzyheavyhitters_tpu.ops import ibdcf
+
+    rng = np.random.default_rng(0)
+    n, L = 8192, 512
+    alpha = rng.integers(0, 2, size=(n, L)).astype(bool)
+    seeds = rng.integers(0, 2**32, size=(n, 2, 4), dtype=np.uint32)
+    side = np.ones(n, bool)
+    alpha, seeds, side = map(jax.device_put, (alpha, seeds, side))
+
+    def run():
+        k0, _ = ibdcf.gen_pair(seeds, alpha, side)
+        # reduce on device; fetching the scalar forces completion (the
+        # tunnel's block_until_ready under-reports otherwise)
+        return int(jnp.sum(k0.cw_seed.astype(jnp.uint32)))
+
+    run()  # compile + warm
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run()
+    dt = (time.perf_counter() - t0) / iters
+    keys_per_sec = n / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "ibdcf_keygen_keys_per_sec_at_data_len_512",
+                "value": round(keys_per_sec, 1),
+                "unit": "keys/s/chip",
+                "vs_baseline": round(keys_per_sec / BASELINE_KEYS_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""Leader binary: client simulation + protocol driver (ref: src/bin/leader.rs).
+
+::
+
+    python -m fuzzyheavyhitters_tpu.bin.leader --config configs/config.json -n 1000
+
+Flow (leader.rs:300-440): keygen throughput report, distribution-specific
+client sampling (zipf site strings with 8-bit augmentation, RideAustin
+coordinates, or COVID-geo), batched key upload, level loop, heavy-hitter CSV.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+
+import numpy as np
+
+from ..ops import ibdcf
+from ..protocol.leader_rpc import RpcLeader
+from ..protocol.rpc import CollectorClient
+from ..utils import config as configmod
+from ..workloads import covid, rides, strings
+
+AUG_LEN = 8  # per-request augmentation bits (ref: leader.rs:331)
+
+RIDES_CSV = "data/RideAustin_Weather.csv"
+COVID_CSV = "data/COVID-19_Case_Surveillance_Public_Use_Data_with_Geography_20250430.csv"
+CENTROIDS_CSV = "data/county_centroids.csv"
+OUTPUT_CSV = "data/ride_heavy_hitters.csv"
+
+
+def _split(addr: str) -> tuple[str, int]:
+    host, port = addr.rsplit(":", 1)
+    return host, int(port)
+
+
+def keygen_report(cfg, rng) -> None:
+    """Key-size / keys-per-second report (ref: leader.rs:90-104, 319-329)."""
+    t0 = time.perf_counter()
+    n = min(cfg.num_sites, 1000)
+    pts = np.stack(
+        [strings.generate_random_bit_vectors(rng, cfg.data_len, cfg.n_dims) for _ in range(n)]
+    )
+    k0, _ = ibdcf.gen_l_inf_ball(pts, 1, rng)
+    dt = time.perf_counter() - t0
+    per_client = sum(np.asarray(x)[0].nbytes for x in k0)
+    print(f"Key size: {per_client} bytes")
+    print(f"Generated {n} keys in {dt:.3f} seconds ({dt / n:.6f} sec/key)")
+
+
+def sample_points(cfg, nreqs: int, rng) -> np.ndarray:
+    """Distribution-selected client points -> bool[nreqs, n_dims, data_len]
+    (ref: leader.rs:332, 372)."""
+    if cfg.distribution == "zipf":
+        pts, _ = strings.zipf_workload(
+            rng, cfg.num_sites, cfg.data_len, cfg.n_dims, cfg.zipf_exponent, nreqs, AUG_LEN
+        )
+        return pts
+    if cfg.distribution == "rides":
+        assert cfg.data_len == 16 and cfg.n_dims == 2, "rides flow is i16 lat/lon"
+        coords = rides.load_or_synthesize_locations(RIDES_CSV, nreqs, seed=42)
+        from ..utils import bits as bitutils
+
+        return np.stack(
+            [
+                np.stack([bitutils.i16_to_ob_bits(int(v)) for v in row])
+                for row in coords
+            ]
+        )
+    if cfg.distribution == "covid":
+        assert cfg.data_len == 64 and cfg.n_dims == 2, "covid flow is f64-bit coords"
+        return covid.sample_covid_locations(
+            COVID_CSV, CENTROIDS_CSV, nreqs, fuzz_factor=float(AUG_LEN)
+        )
+    raise ValueError(f"unknown distribution {cfg.distribution!r}")
+
+
+async def amain() -> None:
+    cfg, _, nreqs = configmod.get_args("Leader", get_n_reqs=True)
+    rng = np.random.default_rng()
+
+    print("Generating keys...")
+    keygen_report(cfg, rng)
+
+    print(f"{cfg.distribution} distribution sampling...")
+    pts = sample_points(cfg, nreqs, rng)
+    if cfg.distribution == "rides":
+        k0, k1 = ibdcf.gen_l_inf_ball(pts, cfg.ball_size, rng)
+    else:
+        k0, k1 = ibdcf.gen_l_inf_ball(pts, cfg.ball_size, rng)
+
+    h0, p0 = _split(cfg.server0)
+    h1, p1 = _split(cfg.server1)
+    c0 = await CollectorClient.connect(h0, p0)
+    c1 = await CollectorClient.connect(h1, p1)
+    await asyncio.gather(c0.call("reset"), c1.call("reset"))
+
+    lead = RpcLeader(cfg, c0, c1)
+    t0 = time.perf_counter()
+    await lead.upload_keys(k0, k1)
+    print(f"AddKeysDone in {time.perf_counter() - t0:.2f}s")
+
+    t0 = time.perf_counter()
+    res = await lead.run(nreqs)
+    print(f"Crawl done in {time.perf_counter() - t0:.2f}s")
+
+    for row, c in zip(res.decode_ints(), res.counts):
+        print(f"Final {row.tolist()} -> {int(c)}")
+    if cfg.distribution == "rides" and res.paths.shape[0]:
+        os.makedirs(os.path.dirname(OUTPUT_CSV), exist_ok=True)
+        rides.save_heavy_hitters(res.paths, OUTPUT_CSV)
+        print(f"Wrote {res.paths.shape[0]} heavy hitters to {OUTPUT_CSV}")
+
+
+def main() -> None:
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

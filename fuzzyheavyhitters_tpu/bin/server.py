@@ -1,0 +1,48 @@
+"""Collector server binary (ref: src/bin/server.rs).
+
+Run one per party::
+
+    python -m fuzzyheavyhitters_tpu.bin.server --config configs/config.json --server_id 0
+    python -m fuzzyheavyhitters_tpu.bin.server --config configs/config.json --server_id 1
+
+Startup order mirrors the reference (server.rs:344-354): the data-plane
+socket between the two servers is established BEFORE the leader-facing RPC
+listener binds, server1 listening / server0 dialing with retries.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..protocol.rpc import CollectorServer
+from ..utils import config as configmod
+
+
+def _split(addr: str) -> tuple[str, int]:
+    host, port = addr.rsplit(":", 1)
+    return host, int(port)
+
+
+async def amain() -> None:
+    cfg, server_id, _ = configmod.get_args("Server", get_server_id=True)
+    assert server_id in (0, 1), f"server_id must be 0 or 1, got {server_id}"
+    host0, port0 = _split(cfg.server0)
+    host1, port1 = _split(cfg.server1)
+    my_host, my_port = (host0, port0) if server_id == 0 else (host1, port1)
+    # data plane rides on server1's port + 1 (ref: server.rs:41, 208-233)
+    peer_host = host1 if server_id == 0 else my_host
+    peer_port = port1 + 1
+
+    server = CollectorServer(server_id, cfg)
+    srv = await server.start(my_host, my_port, peer_host, peer_port)
+    print(f"server {server_id} serving on {my_host}:{my_port}", flush=True)
+    async with srv:
+        await srv.serve_forever()
+
+
+def main() -> None:
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

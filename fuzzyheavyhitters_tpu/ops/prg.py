@@ -53,8 +53,6 @@ _FIXED_KEY = (
 
 DERIVED_BITS = False  # False = reproduce the reference's constant-bit quirk
 
-_MASK32 = jnp.uint32(0xFFFFFFFF)
-
 
 def _rotl(x, n: int):
     return (x << n) | (x >> (32 - n))

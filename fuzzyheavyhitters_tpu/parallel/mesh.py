@@ -1,0 +1,220 @@
+"""Device-mesh execution of the two-server protocol.
+
+The reference's distribution fabric is processes + sockets: two server
+binaries joined by a TCP channel mesh carrying GC/OT traffic
+(ref: server.rs:197-262), rayon threads inside each (SURVEY.md §2
+parallelism table).  The TPU-native fabric is a 2-D ``jax.sharding.Mesh``:
+
+- axis ``servers`` (size 2): the two-party MPC topology.  Party p's keys and
+  frontier live on the devices of mesh row p; the only inter-party traffic —
+  one packed uint32 of share bits per (node, client) per level — moves by a
+  single ``ppermute`` swap across this axis (the ICI replacement for the
+  reference's per-core TCP socket mesh).
+- axis ``data`` (size k): client data parallelism.  The client batch ``N``
+  is sharded k ways (the reference's rayon ``par_iter`` over clients,
+  collect.rs:94-119, become per-shard tensor blocks); per-node counts
+  finish with a ``psum`` over this axis.
+
+Every collective rides the mesh; the host (leader) only sees final counts —
+mirroring the reference's leader↔server RPC split where per-level counts are
+the only thing returned (rpc.rs:60-61).  The sharded kernels are built and
+jitted ONCE per runner; ``level`` and the survivor table are traced scalars,
+so a full ``data_len``-level crawl compiles exactly two programs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import prg
+from ..ops.ibdcf import IbDcfKeyBatch
+from ..protocol import collect
+from ..protocol.collect import EvalState, Frontier
+
+SERVERS = "servers"
+DATA = "data"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """2 × (n/2) mesh: first axis the two servers, rest data parallel."""
+    if devices is None:
+        devices = jax.devices()[: n_devices or len(jax.devices())]
+    n = len(devices)
+    assert n % 2 == 0, f"need an even device count for the 2-server axis, got {n}"
+    arr = np.asarray(devices).reshape(2, n // 2)
+    return Mesh(arr, (SERVERS, DATA))
+
+
+def _stack_parties(t0, t1):
+    return jax.tree.map(lambda a, b: jnp.stack([jnp.asarray(a), jnp.asarray(b)]), t0, t1)
+
+
+class MeshRunner:
+    """Holds both parties' device-resident state, sharded over the mesh.
+
+    Leading axes of every tensor: [party=2, ...] with party sharded over
+    ``servers`` and the client axis sharded over ``data``.  The PRG bit mode
+    (prg.DERIVED_BITS) is captured at construction; a runner never mixes
+    modes mid-crawl.
+    """
+
+    def __init__(self, mesh: Mesh, keys0: IbDcfKeyBatch, keys1: IbDcfKeyBatch, f_max: int):
+        self.mesh = mesh
+        self.f_max = f_max
+        self.n_dims = keys0.cw_seed.shape[1]
+        self.data_len = keys0.data_len
+        self._derived = prg.DERIVED_BITS
+        n = keys0.cw_seed.shape[0]
+        assert n % mesh.shape[DATA] == 0, (
+            f"client count {n} must divide the data axis {mesh.shape[DATA]}"
+        )
+        keys = _stack_parties(keys0, keys1)  # [2, N, d, 2, ...]
+        key_spec = IbDcfKeyBatch(
+            key_idx=P(SERVERS, DATA),
+            root_seed=P(SERVERS, DATA),
+            cw_seed=P(SERVERS, DATA),
+            cw_bits=P(SERVERS, DATA),
+            cw_y_bits=P(SERVERS, DATA),
+        )
+        self._key_spec = key_spec
+        self.keys = jax.tree.map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), keys, key_spec
+        )
+        self.alive_keys = jax.device_put(
+            jnp.ones((2, n), bool), NamedSharding(mesh, P(SERVERS, DATA))
+        )
+        self._frontier_spec = Frontier(
+            states=EvalState(
+                seed=P(SERVERS, None, DATA),
+                bit=P(SERVERS, None, DATA),
+                y_bit=P(SERVERS, None, DATA),
+            ),
+            alive=P(SERVERS, None),
+        )
+        self.frontier: Frontier | None = None
+        self._masks = collect.pattern_masks(self.n_dims)
+        self._build_kernels()
+
+    def _build_kernels(self):
+        mesh, f_max, derived = self.mesh, self.f_max, self._derived
+        masks = jnp.asarray(self._masks)
+        kspec, fspec = self._key_spec, self._frontier_spec
+
+        def init_body(keys):
+            keys = jax.tree.map(lambda a: a[0], keys)  # drop party block axis
+            f = collect.tree_init(keys, f_max)
+            return jax.tree.map(lambda a: a[None], f)
+
+        self._init_fn = jax.jit(
+            jax.shard_map(init_body, mesh=mesh, in_specs=(kspec,), out_specs=fspec)
+        )
+
+        def counts_body(keys, frontier, alive_keys, level):
+            keys = jax.tree.map(lambda a: a[0], keys)
+            frontier = jax.tree.map(lambda a: a[0], frontier)
+            alive = alive_keys[0]
+            packed = collect._expand_share_bits_jit(keys, frontier, level, derived)
+            # one u32 per (node, client): the whole inter-party data plane
+            peer = jax.lax.ppermute(packed, SERVERS, perm=[(0, 1), (1, 0)])
+            cnt = collect.counts_by_pattern(packed, peer, masks, alive, frontier.alive)
+            cnt = jax.lax.psum(cnt, DATA)
+            # both parties compute identical counts (the compare is
+            # symmetric); psum/2 over servers makes replication explicit
+            cnt = jax.lax.psum(cnt, SERVERS) // 2
+            return cnt
+
+        self._counts_fn = jax.jit(
+            jax.shard_map(
+                counts_body,
+                mesh=mesh,
+                in_specs=(kspec, fspec, P(SERVERS, DATA), P()),
+                out_specs=P(),
+            )
+        )
+
+        def adv_body(keys, frontier, level, parent, pat_bits, n_alive):
+            keys = jax.tree.map(lambda a: a[0], keys)
+            frontier = jax.tree.map(lambda a: a[0], frontier)
+            new = collect._advance_jit(
+                keys, frontier, level, parent, pat_bits, n_alive, derived
+            )
+            return jax.tree.map(lambda a: a[None], new)
+
+        self._advance_fn = jax.jit(
+            jax.shard_map(
+                adv_body,
+                mesh=mesh,
+                in_specs=(kspec, fspec, P(), P(None), P(None, None), P()),
+                out_specs=fspec,
+            )
+        )
+
+    # -- leader-facing ops --------------------------------------------------
+
+    def tree_init(self):
+        self.frontier = self._init_fn(self.keys)
+
+    def level_counts(self, level: int) -> np.ndarray:
+        """Crawl counts for every child of the current frontier: the
+        expand → exchange(ppermute) → compare → psum pipeline."""
+        return np.asarray(
+            self._counts_fn(
+                self.keys, self.frontier, self.alive_keys, jnp.int32(level)
+            )
+        )
+
+    def advance(self, level: int, parent_idx, pattern_bits, n_alive: int):
+        self.frontier = self._advance_fn(
+            self.keys,
+            self.frontier,
+            jnp.int32(level),
+            jnp.asarray(parent_idx, jnp.int32),
+            jnp.asarray(pattern_bits, bool),
+            jnp.int32(n_alive),
+        )
+
+
+class MeshLeader:
+    """Level-loop driver over a MeshRunner (host-side thresholds/paths,
+    ref: leader.rs:185-297 — same bookkeeping as protocol.driver.Leader)."""
+
+    def __init__(self, runner: MeshRunner):
+        self.r = runner
+        self.paths = None
+        self.n_nodes = 0
+
+    def run(self, nreqs: int, threshold: float):
+        from ..protocol.driver import CrawlResult
+
+        r = self.r
+        d = r.n_dims
+        r.tree_init()
+        self.paths = np.zeros((1, d, 0), bool)
+        self.n_nodes = 1
+        counts_kept = np.zeros(0, np.uint32)
+        for level in range(r.data_len):
+            counts = r.level_counts(level)
+            thresh = max(1, int(threshold * nreqs))
+            keep = counts >= thresh
+            keep[self.n_nodes :, :] = False
+            parent, pattern, n_alive = collect.compact_survivors(keep, r.f_max)
+            pat_bits = collect.pattern_to_bits(pattern, d)
+            if n_alive == 0:
+                return CrawlResult(
+                    paths=np.zeros((0, d, level + 1), bool),
+                    counts=np.zeros(0, np.uint32),
+                )
+            r.advance(level, parent, pat_bits, n_alive)
+            new_paths = np.zeros((n_alive, d, self.paths.shape[-1] + 1), bool)
+            for i in range(n_alive):
+                new_paths[i, :, :-1] = self.paths[parent[i]]
+                new_paths[i, :, -1] = pat_bits[i]
+            self.paths = new_paths
+            self.n_nodes = n_alive
+            counts_kept = counts[parent[:n_alive], pattern[:n_alive]]
+        return CrawlResult(paths=self.paths, counts=counts_kept)

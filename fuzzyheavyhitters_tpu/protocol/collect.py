@@ -1,0 +1,215 @@
+"""Aggregation engine: the prefix-tree frontier crawl as device kernels.
+
+TPU-first redesign of the reference's ``KeyCollection`` state machine
+(ref: src/collect.rs:28-507).  The reference walks Rust object trees —
+``TreeNode{path, key_states}`` per node, rayon loops over clients, and it
+re-evaluates every client's FSS state once per child pattern
+(``make_tree_node`` per search string, collect.rs:378-391), costing
+``2^d × d×2`` PRG calls per (node, client).  Here:
+
+- the frontier is a **padded tensor** ``[F, N, d, 2]`` of eval states with an
+  alive-node mask (SURVEY.md §7 hard part 4) — no objects, no ragged shapes;
+- one batched PRG expansion per (node, client, dim, side) yields BOTH
+  children at once, serving all ``2^d`` child patterns — a ``2^d``-fold
+  saving over the reference's per-pattern re-evaluation;
+- each (node, client)'s both-direction share bits pack into ONE uint32
+  (bit position ``j*4 + side*2 + dir`` for dim j ≤ 8), so per-pattern ball
+  membership is a single ``(p0 ^ p1) & pattern_mask == 0`` — and that uint32
+  is also the only thing the two servers ever need to exchange per level;
+- paths live with the leader (host), not on device: expansion and prune
+  orders are deterministic (child c of node f sits at ``f * 2^d + c``,
+  pattern bit j = ``(c >> j) & 1``, matching the reference's
+  ``all_bit_vectors`` child order, lib.rs:125-129), so the leader
+  reconstructs paths from its own keep masks.
+
+Memory plan: the counts pass emits only packed share bits (4 B per
+node·client) — the expand → correction → pack pipeline is one fused XLA
+program, so child seeds never materialize in HBM; after the leader prunes,
+the surviving children's states come from one more expansion of their
+parents (``advance``).  Per level this is ``(F + F') × N × d × 2`` PRG
+expansions — still ``≈ 2^d / 2`` times fewer than the reference — and the
+peak HBM footprint is the parent frontier plus the packed-bit tensor,
+independent of ``2^d``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import ibdcf, prg
+from ..ops.ibdcf import EvalState, IbDcfKeyBatch
+
+MAX_DIMS = 8  # packed-u32 layout holds d*4 bits
+
+
+class Frontier(NamedTuple):
+    """Per-server frontier state for ``F`` (padded) tree nodes.
+
+    states: EvalState over ``[F, N, d, 2]`` (node, client, dim, left/right);
+    alive:  bool[F] node-liveness mask (dead slots are padding).
+    """
+
+    states: EvalState
+    alive: jax.Array
+
+    @property
+    def f_max(self) -> int:
+        return self.states.bit.shape[0]
+
+
+def tree_init(keys: IbDcfKeyBatch, f_max: int) -> Frontier:
+    """Root frontier: one alive node whose states are eval_init of every
+    (client, dim, side) key (ref: collect.rs:67-92)."""
+    root = ibdcf.eval_init(keys)  # [N, d, 2]
+    pad = lambda a: jnp.broadcast_to(a[None], (f_max,) + a.shape)
+    alive = jnp.zeros((f_max,), bool).at[0].set(True)
+    return Frontier(states=EvalState(*[pad(x) for x in root]), alive=alive)
+
+
+def _bit_positions(d: int):
+    """bit position of (dim j, side s, direction r) in the packed uint32."""
+    j = np.arange(d)[:, None, None]
+    s = np.arange(2)[None, :, None]
+    r = np.arange(2)[None, None, :]
+    return (j * 4 + s * 2 + r).astype(np.uint32)  # [d, 2, 2]
+
+
+def pattern_masks(d: int) -> np.ndarray:
+    """uint32[2^d] — for child pattern c, the packed-bit positions that a
+    membership test must compare: both sides of every dim, at direction
+    ``(c >> j) & 1`` (child order: ref lib.rs:125-129)."""
+    assert d <= MAX_DIMS
+    pos = _bit_positions(d)
+    masks = []
+    for c in range(1 << d):
+        m = np.uint32(0)
+        for j in range(d):
+            r = (c >> j) & 1
+            m |= (np.uint32(1) << pos[j, 0, r]) | (np.uint32(1) << pos[j, 1, r])
+        masks.append(m)
+    return np.array(masks, dtype=np.uint32)
+
+
+def expand_share_bits(keys: IbDcfKeyBatch, frontier: Frontier, level) -> jax.Array:
+    """One PRG expansion of the whole frontier -> packed share bits.
+
+    Returns uint32[F, N]: for every (node, client), the share bits
+    ``y_bit ^ bit`` of BOTH child directions of every (dim, side) key,
+    packed at ``_bit_positions`` (the tensor twin of collect.rs:393-410's
+    per-(node,client) left||right bit strings — ours carries both
+    directions so all 2^d patterns read from it).
+
+    ``level`` may be traced; the same value must hold for the whole frontier
+    (the crawl is level-synchronous, ref: leader.rs:417-440).
+    """
+    return _expand_share_bits_jit(keys, frontier, level, prg.DERIVED_BITS)
+
+
+@partial(jax.jit, static_argnames=("derived_bits",))
+def _expand_share_bits_jit(keys, frontier, level, derived_bits):
+    cw_seed, cw_bits, cw_y = ibdcf.level_cw(keys, level)  # [N,d,2,(4|2)]
+    st = frontier.states  # leaves [F, N, d, 2(,4)]
+    # one fully-batched expansion over (node, client, dim, side); XLA fuses
+    # expand -> correction -> pack, so child seeds never hit HBM
+    _, _, tau_b, tau_y = prg.expand(st.seed, derived_bits)  # [F,N,d,2,2]
+    t = st.bit[..., None]
+    nb = jnp.where(t, tau_b ^ cw_bits, tau_b)  # cw broadcasts over F
+    ny = jnp.where(t, tau_y ^ cw_y, tau_y)
+    ny = ny ^ st.y_bit[..., None]
+    share = nb ^ ny  # share bit = y ^ t per direction
+    pos = jnp.asarray(_bit_positions(share.shape[-3]))  # [d, 2, 2]
+    return jnp.sum(
+        share.astype(jnp.uint32) << pos, axis=(-3, -2, -1), dtype=jnp.uint32
+    )  # [F, N] uint32
+
+
+@jax.jit
+def counts_by_pattern(
+    packed_self: jax.Array,
+    packed_peer: jax.Array,
+    masks: jax.Array,
+    alive_keys: jax.Array,
+    alive_nodes: jax.Array,
+) -> jax.Array:
+    """uint32[F, 2^d] per-child candidate counts.
+
+    Membership of client i's ball in child (f, c) ⇔ the two servers' share
+    bits agree on every compared position: ``(p0 ^ p1) & masks[c] == 0``
+    (the plaintext of the GC equality test, ref: equalitytest.rs:130-146,
+    reconstructed as the leader would, collect.rs:945-964).  Dead clients
+    and dead nodes contribute zero (liveness gate, ref: collect.rs:495).
+    """
+    diff = packed_self ^ packed_peer  # [F, N]
+    eq = (diff[:, :, None] & masks[None, None, :]) == 0  # [F, N, 2^d]
+    eq = eq & alive_keys[None, :, None] & alive_nodes[:, None, None]
+    return jnp.sum(eq, axis=1, dtype=jnp.uint32)  # [F, 2^d]
+
+
+def advance(
+    keys: IbDcfKeyBatch,
+    frontier: Frontier,
+    level,
+    parent_idx: jax.Array,
+    pattern_bits: jax.Array,
+    n_alive: jax.Array,
+) -> Frontier:
+    """Materialize the surviving children as the next frontier.
+
+    parent_idx:   int32[F'] parent slot per surviving child (padded);
+    pattern_bits: bool[F', d] child pattern per survivor;
+    n_alive:      number of real entries (rest is padding).
+
+    Gathers the parents' states and advances one level with the pattern's
+    per-dim direction (both keys of a dim take the same bit — the interval
+    pair walks together, ref: collect.rs:100, ibDCF.rs:120-131).
+    """
+    return _advance_jit(
+        keys, frontier, level, parent_idx, pattern_bits, n_alive, prg.DERIVED_BITS
+    )
+
+
+@partial(jax.jit, static_argnames=("derived_bits",))
+def _advance_jit(keys, frontier, level, parent_idx, pattern_bits, n_alive, derived_bits):
+    cw = ibdcf.level_cw(keys, level)
+    st = frontier.states
+    parents = jax.tree.map(lambda a: a[parent_idx], st)  # [F', N, d, 2]
+    direction = jnp.broadcast_to(
+        pattern_bits[:, None, :, None], parents.bit.shape
+    )  # child pattern bit of each dim, same for both keys of the dim
+    states = ibdcf._eval_bit_jit(cw, parents, direction, derived_bits)
+    f_max = parent_idx.shape[0]
+    alive = jnp.arange(f_max) < n_alive
+    return Frontier(states=states, alive=alive)
+
+
+# ---------------------------------------------------------------------------
+# Host-side compaction helper (leader-side prune bookkeeping)
+# ---------------------------------------------------------------------------
+
+
+def compact_survivors(keep: np.ndarray, f_max: int):
+    """keep: bool[F, 2^d] -> (parent_idx int32[f_max], pattern int32[f_max],
+    n_alive) padded with zeros.  Raises if survivors exceed f_max — the
+    padded-frontier equivalent of the reference's unbounded Vec growth."""
+    f, c = np.nonzero(keep)
+    if len(f) > f_max:
+        raise ValueError(
+            f"{len(f)} surviving nodes exceed f_max={f_max}; "
+            "raise f_max (recompiles) or the threshold"
+        )
+    parent = np.zeros(f_max, np.int32)
+    pattern = np.zeros(f_max, np.int32)
+    parent[: len(f)] = f
+    pattern[: len(f)] = c
+    return parent, pattern, len(f)
+
+
+def pattern_to_bits(pattern: np.ndarray, d: int) -> np.ndarray:
+    """int32[F'] child pattern ids -> bool[F', d] per-dim direction bits
+    (bit j = (c >> j) & 1, ref: lib.rs:125-129)."""
+    return ((pattern[:, None] >> np.arange(d)[None]) & 1).astype(bool)

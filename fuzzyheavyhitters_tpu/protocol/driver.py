@@ -1,0 +1,130 @@
+"""In-process protocol driver: a leader and two colocated server states.
+
+The correctness backbone of the framework — both servers' state machines run
+in one process (the integration-test shape the reference intended with its
+commented-out ``collect_test.rs``, SURVEY.md §4), with the trusted-exchange
+data plane: the per-(node,client) packed share bits are compared directly
+instead of passing through the GC+OT 2PC (functionally identical counts —
+exactly what the leader reconstructs anyway via ``keep_values``,
+ref: collect.rs:945-964 — with semi-honest security dropped).  The secure
+data plane drops in behind the same ``counts_by_pattern`` seam.
+
+Level-loop semantics mirror the reference leader (ref: leader.rs:185-297):
+
+- threshold = ``max(1, threshold · nreqs)`` per level (leader.rs:193-194);
+- ``data_len - 1`` inner levels then one last level (leader.rs:417-438);
+- prune keeps only above-threshold children (leader.rs:229-234);
+- paths decode MSB-first per dim; heavy hitters are the surviving leaves.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+import numpy as np
+
+from ..ops.ibdcf import IbDcfKeyBatch
+from . import collect
+
+
+@dataclass
+class ServerState:
+    """One collector server's state (ref: server.rs:44-52 wraps the same)."""
+
+    keys: IbDcfKeyBatch  # [N, d, 2]
+    alive_keys: np.ndarray  # bool[N] liveness flags (ref: collect.rs:32)
+    frontier: collect.Frontier | None = None
+
+
+@dataclass
+class CrawlResult:
+    paths: np.ndarray  # bool[H, d, L] per-dim MSB-first paths
+    counts: np.ndarray  # uint32[H]
+
+    def decode_ints(self) -> np.ndarray:
+        """paths -> int[H, d] leaf values (MSB-first per dim)."""
+        L = self.paths.shape[-1]
+        weights = 1 << np.arange(L - 1, -1, -1)
+        return (self.paths.astype(np.int64) * weights).sum(-1)
+
+
+@dataclass
+class Leader:
+    """Drives two ServerStates level by level (ref: leader.rs:185-297)."""
+
+    server0: ServerState
+    server1: ServerState
+    n_dims: int
+    data_len: int
+    f_max: int = 256
+    # leader-side bookkeeping
+    paths: np.ndarray = field(default=None)  # bool[F, d, level]
+    n_nodes: int = 0
+
+    def tree_init(self):
+        for s in (self.server0, self.server1):
+            s.frontier = collect.tree_init(s.keys, self.f_max)
+        self.paths = np.zeros((1, self.n_dims, 0), bool)
+        self.n_nodes = 1
+
+    def run_level(self, level: int, nreqs: int, threshold: float) -> int:
+        """One crawl->threshold->prune round; returns surviving node count.
+
+        Trusted-exchange mode: counts are exact (the reconstruction
+        ``v0 - v1`` of ref collect.rs:945-964, computed directly).
+        """
+        d = self.n_dims
+        masks = collect.pattern_masks(d)
+        p0 = collect.expand_share_bits(self.server0.keys, self.server0.frontier, level)
+        p1 = collect.expand_share_bits(self.server1.keys, self.server1.frontier, level)
+        counts = collect.counts_by_pattern(
+            p0,
+            p1,
+            masks,
+            np.asarray(self.server0.alive_keys),
+            self.server0.frontier.alive,
+        )
+        counts = np.asarray(counts)  # [F, 2^d]
+
+        thresh = max(1, int(threshold * nreqs))  # ref: leader.rs:193-194
+        keep = counts >= thresh  # [F, 2^d]
+        keep[self.n_nodes :, :] = False
+        parent, pattern, n_alive = collect.compact_survivors(keep, self.f_max)
+        pat_bits = collect.pattern_to_bits(pattern, d)
+
+        for s in (self.server0, self.server1):
+            s.frontier = collect.advance(
+                s.keys, s.frontier, level, parent, pat_bits, n_alive
+            )
+
+        # leader-side path bookkeeping (child bit j = (pattern >> j) & 1)
+        new_paths = np.zeros((n_alive, d, self.paths.shape[-1] + 1), bool)
+        for i in range(n_alive):
+            new_paths[i, :, :-1] = self.paths[parent[i]]
+            new_paths[i, :, -1] = pat_bits[i]
+        self.paths = new_paths
+        self.n_nodes = n_alive
+        self._last_counts = counts[parent[:n_alive], pattern[:n_alive]]
+        return n_alive
+
+    def run(self, nreqs: int, threshold: float) -> CrawlResult:
+        """Full crawl: init + data_len levels + final reconstruction
+        (ref: leader.rs:417-438 then final_shares at :282-297)."""
+        self.tree_init()
+        for level in range(self.data_len):
+            n = self.run_level(level, nreqs, threshold)
+            if n == 0:
+                return CrawlResult(
+                    paths=np.zeros((0, self.n_dims, level + 1), bool),
+                    counts=np.zeros(0, np.uint32),
+                )
+        return CrawlResult(paths=self.paths, counts=self._last_counts)
+
+
+def make_servers(
+    keys0: IbDcfKeyBatch, keys1: IbDcfKeyBatch
+) -> tuple[ServerState, ServerState]:
+    n = keys0.cw_seed.shape[0]
+    alive = np.ones(n, bool)
+    return ServerState(keys0, alive.copy()), ServerState(keys1, alive.copy())

@@ -1,0 +1,101 @@
+"""RPC leader: drives two collector servers over the control plane.
+
+The process-level twin of protocol/driver.Leader, speaking the 8-verb RPC
+(ref: src/bin/leader.rs:185-297): batched key upload, level loop with
+``tree_crawl`` → reconstruct ``v0 - v1`` → threshold → fused prune/advance,
+the F255 last level, and final heavy-hitter reconstruction with the same
+``max(1, threshold·nreqs)`` floor (leader.rs:193-194, 245-246).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+
+from ..ops.fields import F255, FE62
+from ..ops.ibdcf import IbDcfKeyBatch
+from ..utils.config import Config
+from . import collect
+from .driver import CrawlResult
+from .rpc import CollectorClient
+
+
+def _key_chunk(keys: IbDcfKeyBatch, sl: slice):
+    return tuple(np.asarray(leaf)[sl] for leaf in keys)
+
+
+class RpcLeader:
+    def __init__(self, cfg: Config, client0: CollectorClient, client1: CollectorClient):
+        self.cfg = cfg
+        self.c0, self.c1 = client0, client1
+        self.paths: np.ndarray | None = None
+        self.n_nodes = 0
+
+    async def _both(self, verb: str, req=None):
+        return await asyncio.gather(self.c0.call(verb, req), self.c1.call(verb, req))
+
+    async def upload_keys(self, keys0: IbDcfKeyBatch, keys1: IbDcfKeyBatch):
+        """Batched async key upload (ref: leader.rs:340-364: addkey batches
+        with bounded in-flight concurrency)."""
+        n = np.asarray(keys0.cw_seed).shape[0]
+        bs = max(1, self.cfg.addkey_batch_size)
+        pending = []
+        for lo in range(0, n, bs):
+            sl = slice(lo, min(lo + bs, n))
+            pending.append(self.c0.call("add_keys", {"keys": _key_chunk(keys0, sl)}))
+            pending.append(self.c1.call("add_keys", {"keys": _key_chunk(keys1, sl)}))
+            if len(pending) >= 16:  # bounded in-flight window
+                await asyncio.gather(*pending)
+                pending = []
+        if pending:
+            await asyncio.gather(*pending)
+
+    async def run(self, nreqs: int) -> CrawlResult:
+        cfg = self.cfg
+        d, L = cfg.n_dims, cfg.data_len
+        await self._both("tree_init")
+        self.paths = np.zeros((1, d, 0), bool)
+        self.n_nodes = 1
+        thresh = max(1, int(cfg.threshold * nreqs))
+        counts_kept = np.zeros(0, np.uint32)
+        for level in range(L):
+            last = level == L - 1
+            verb = "tree_crawl_last" if last else "tree_crawl"
+            s0, s1 = await self._both(verb, {"level": level})
+            if last:
+                v = np.asarray(F255.sub(s0, s1))  # leader-side reconstruct
+                counts = v[..., 0].astype(np.uint32)  # counts < 2^32 by def
+                assert not np.any(v[..., 1:]), "non-count residue in F255 share"
+            else:
+                counts = np.asarray(FE62.canon(FE62.sub(s0, s1))).astype(np.uint32)
+            keep = counts >= thresh
+            keep[self.n_nodes :, :] = False
+            parent, pattern, n_alive = collect.compact_survivors(keep, cfg.f_max)
+            pat_bits = collect.pattern_to_bits(pattern, d)
+            if n_alive == 0:
+                return CrawlResult(
+                    paths=np.zeros((0, d, level + 1), bool),
+                    counts=np.zeros(0, np.uint32),
+                )
+            if last:
+                await self._both("tree_prune_last", {"n_alive": n_alive})
+            else:
+                await self._both(
+                    "tree_prune",
+                    {
+                        "level": level,
+                        "parent_idx": parent,
+                        "pattern_bits": pat_bits,
+                        "n_alive": n_alive,
+                    },
+                )
+            new_paths = np.zeros((n_alive, d, self.paths.shape[-1] + 1), bool)
+            for i in range(n_alive):
+                new_paths[i, :, :-1] = self.paths[parent[i]]
+                new_paths[i, :, -1] = pat_bits[i]
+            self.paths = new_paths
+            self.n_nodes = n_alive
+            counts_kept = counts[parent[:n_alive], pattern[:n_alive]]
+        await self._both("final_shares")
+        return CrawlResult(paths=self.paths, counts=counts_kept)

@@ -1,0 +1,273 @@
+"""Control plane: leader↔server RPC + server↔server data-plane socket.
+
+Process topology mirrors the reference (SURVEY.md §2 "distributed
+communication backend"):
+
+- **Control plane** — leader connects to both servers and drives the 8-verb
+  protocol of the reference's tarpc ``Collector`` service (ref: rpc.rs:56-66):
+  ``reset, add_keys, tree_init, tree_crawl, tree_crawl_last, tree_prune,
+  tree_prune_last, final_shares``.  Transport: length-prefixed pickle over
+  TCP via asyncio (the tarpc+bincode analogue; pickle protocol 5 gives
+  zero-copy numpy buffers).
+- **Data plane** — one server↔server TCP connection carrying the packed
+  share-bit tensors per level (server1 listens on ``port+1``, server0 dials
+  with retries — the reference's GC-mesh bootstrap order, server.rs:197-262,
+  collapsed from ``num_cpus`` sockets to one because the exchange is a single
+  batched tensor, not per-thread GC traffic).  On a shared TPU pod the same
+  exchange rides ICI via parallel/mesh.py instead.
+
+Counts come back as **field-element shares**: both servers derive a common
+pseudorandom mask stream from a shared seed — the reference hardcodes the
+same PRG seed on both servers ("XXX This is bogus", server.rs:331-332) — so
+server0 returns ``count + r`` and server1 returns ``r``, and the leader
+reconstructs ``v0 - v1`` exactly as ``keep_values`` does
+(ref: collect.rs:945-989).  Inner levels use FE62, the last level F255
+(ref: rpc.rs:60-62 FE vs FieldElm).
+
+Divergence, by design: the reference's ``tree_prune`` carries an alive list
+and servers rebuild child nodes eagerly; here prune and child
+materialization are fused — the leader sends (parent_idx, pattern, n_alive)
+and the server advances only the survivors (see protocol/collect.py's
+memory plan).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import struct
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..ops import ibdcf, prg
+from ..ops.fields import F255, FE62
+from ..ops.ibdcf import IbDcfKeyBatch
+from ..utils.config import Config
+from . import collect
+
+_HDR = struct.Struct("<Q")
+SHARED_MASK_SEED = b"XXX This is bog\x00"  # 16 B, ref: server.rs:331-332
+
+
+async def _send(writer: asyncio.StreamWriter, obj) -> None:
+    data = pickle.dumps(obj, protocol=5)
+    writer.write(_HDR.pack(len(data)) + data)
+    await writer.drain()
+
+
+async def _recv(reader: asyncio.StreamReader):
+    hdr = await reader.readexactly(_HDR.size)
+    (n,) = _HDR.unpack(hdr)
+    return pickle.loads(await reader.readexactly(n))
+
+
+def _mask_words(level: int, n: int, blocks_for: int) -> np.ndarray:
+    """Shared pseudorandom mask words for one level (both servers derive the
+    same stream, so shares cancel on reconstruction)."""
+    seed = prg.seeds_from_bytes(SHARED_MASK_SEED)[0].copy()
+    seed[3] ^= np.uint32(level)
+    return np.asarray(prg.stream_words(seed, n * blocks_for)).reshape(n, blocks_for)
+
+
+def mask_fe62(level: int, n: int) -> np.ndarray:
+    return np.asarray(FE62.sample(_mask_words(level, n, 4)))
+
+
+def mask_f255(level: int, n: int) -> np.ndarray:
+    return np.asarray(F255.sample(_mask_words(level, n, 8)))
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CollectorServer:
+    """One collector server process (ref: server.rs:44-172).
+
+    ``server_id`` 0 dials the peer, 1 listens (ref: server.rs:208-233).
+    """
+
+    server_id: int
+    cfg: Config
+    keys_parts: list = field(default_factory=list)
+    keys: IbDcfKeyBatch | None = None
+    alive_keys: np.ndarray | None = None
+    frontier: collect.Frontier | None = None
+    _peer_reader: asyncio.StreamReader | None = None
+    _peer_writer: asyncio.StreamWriter | None = None
+
+    # -- verbs (ref: rpc.rs:56-66) ---------------------------------------
+
+    async def reset(self, _req) -> bool:
+        self.keys_parts.clear()
+        self.keys = None
+        self.alive_keys = None
+        self.frontier = None
+        return True
+
+    async def add_keys(self, req) -> bool:
+        """req: pytree-of-arrays key batch chunk [B, d, 2] (the tensor form
+        of AddKeysRequest, ref: rpc.rs:13-15)."""
+        self.keys_parts.append(IbDcfKeyBatch(*req["keys"]))
+        return True
+
+    async def tree_init(self, _req) -> bool:
+        assert self.keys_parts, "no keys added"
+        self.keys = IbDcfKeyBatch(
+            *[
+                np.concatenate([np.asarray(p[i]) for p in self.keys_parts])
+                for i in range(len(self.keys_parts[0]))
+            ]
+        )
+        n = self.keys.cw_seed.shape[0]
+        self.alive_keys = np.ones(n, bool)
+        self.frontier = collect.tree_init(self.keys, self.cfg.f_max)
+        return True
+
+    async def _crawl_counts(self, level: int) -> np.ndarray:
+        packed = collect.expand_share_bits(self.keys, self.frontier, level)
+        packed_np = np.asarray(packed)
+        # data plane: swap packed share bits with the peer server
+        await _send(self._peer_writer, packed_np)
+        peer = await _recv(self._peer_reader)
+        masks = collect.pattern_masks(self.keys.cw_seed.shape[1])
+        counts = collect.counts_by_pattern(
+            packed, peer, masks, self.alive_keys, self.frontier.alive
+        )
+        return np.asarray(counts)
+
+    async def tree_crawl(self, req) -> np.ndarray:
+        """-> FE62 shares of per-child counts [F, 2^d] (ref: rpc.rs:60)."""
+        level = req["level"]
+        counts = await self._crawl_counts(level)
+        r = mask_fe62(level, counts.size).reshape(counts.shape)
+        if self.server_id == 0:
+            return np.asarray(FE62.add(counts.astype(np.uint64), r))
+        return r
+
+    async def tree_crawl_last(self, req) -> np.ndarray:
+        """-> F255 shares [F, 2^d, 8] for the final level (ref: rpc.rs:61)."""
+        level = req["level"]
+        counts = await self._crawl_counts(level)
+        r = mask_f255(level, counts.size).reshape(counts.shape + (8,))
+        if self.server_id == 0:
+            c = np.zeros(counts.shape + (8,), np.uint32)
+            c[..., 0] = counts
+            return np.asarray(F255.add(c, r))
+        return r
+
+    async def tree_prune(self, req) -> bool:
+        """Fused prune+advance: materialize surviving children
+        (ref: rpc.rs:63 tree_prune + collect.rs:918-929)."""
+        self.frontier = collect.advance(
+            self.keys,
+            self.frontier,
+            req["level"],
+            np.asarray(req["parent_idx"], np.int32),
+            np.asarray(req["pattern_bits"], bool),
+            int(req["n_alive"]),
+        )
+        return True
+
+    async def tree_prune_last(self, req) -> bool:
+        """Last level keeps no child states — only the survivor bookkeeping
+        (ref: collect.rs:931-942); nothing to advance."""
+        return True
+
+    async def final_shares(self, req) -> dict:
+        """Re-serve the surviving leaves' count shares (ref: rpc.rs:65,
+        collect.rs:993-1004; paths live with the leader here)."""
+        return {"server_id": self.server_id}
+
+    # -- wiring ----------------------------------------------------------
+
+    _VERBS = (
+        "reset",
+        "add_keys",
+        "tree_init",
+        "tree_crawl",
+        "tree_crawl_last",
+        "tree_prune",
+        "tree_prune_last",
+        "final_shares",
+    )
+
+    async def _handle_leader(self, reader, writer):
+        try:
+            while True:
+                verb, req = await _recv(reader)
+                assert verb in self._VERBS, verb
+                resp = await getattr(self, verb)(req)
+                await _send(writer, resp)
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    async def start(self, host: str, port: int, peer_host: str, peer_port: int):
+        """Bring up the data plane FIRST (like the reference: GC mesh before
+        the RPC listener, server.rs:344-354), then serve the leader."""
+        if self.server_id == 1:
+            srv = await asyncio.start_server(self._on_peer, host, peer_port)
+            self._peer_ready = asyncio.Event()
+            self._peer_srv = srv
+            await self._peer_ready.wait()
+        else:
+            for attempt in range(20):  # connect_with_retries_tcp, server.rs:235
+                try:
+                    r, w = await asyncio.open_connection(peer_host, peer_port)
+                    break
+                except OSError:
+                    await asyncio.sleep(0.25)
+            else:
+                raise ConnectionError("peer data-plane unreachable")
+            self._peer_reader, self._peer_writer = r, w
+        self._rpc_srv = await asyncio.start_server(self._handle_leader, host, port)
+        return self._rpc_srv
+
+    async def _on_peer(self, reader, writer):
+        self._peer_reader, self._peer_writer = reader, writer
+        self._peer_ready.set()
+
+
+# ---------------------------------------------------------------------------
+# Leader client
+# ---------------------------------------------------------------------------
+
+
+class CollectorClient:
+    """Leader-side RPC stub (the tarpc-generated client analogue)."""
+
+    def __init__(self, reader, writer):
+        self._r, self._w = reader, writer
+        # one in-flight request per connection: the framing carries no
+        # request ids (unlike tarpc), so send+recv must be atomic.  Callers
+        # get pipelining by opening more connections, not by interleaving.
+        self._lock = asyncio.Lock()
+
+    @classmethod
+    async def connect(cls, host: str, port: int, retries: int = 40):
+        for _ in range(retries):
+            try:
+                r, w = await asyncio.open_connection(host, port)
+                return cls(r, w)
+            except OSError:
+                await asyncio.sleep(0.25)
+        raise ConnectionError(f"server {host}:{port} unreachable")
+
+    async def call(self, verb: str, req=None):
+        async with self._lock:
+            await _send(self._w, (verb, req or {}))
+            return await _recv(self._r)
+
+    def __getattr__(self, verb):
+        if verb.startswith("_"):
+            raise AttributeError(verb)
+
+        async def _verb(req=None):
+            return await self.call(verb, req)
+
+        return _verb

@@ -122,6 +122,22 @@ def bitvec_to_i16(bits) -> int:
     return v - 0x10000 if v >= 0x8000 else v
 
 
+def i16_to_ob_bits(value: int) -> np.ndarray:
+    """i16 -> 16 bools, MSB-first **offset-binary** (sign bit flipped).
+
+    Unsigned lexicographic order on these strings equals signed order on the
+    values, which is what the ibDCF comparator needs; raw two's complement
+    (the reference's encoding, sample_driving_data.rs:25) sorts negatives
+    above positives and silently breaks zero-crossing intervals."""
+    return int_to_bits(16, (int(value) & 0xFFFF) ^ 0x8000)
+
+
+def ob_bits_to_i16(bits) -> int:
+    """16 bools MSB-first offset-binary -> i16 (inverse of i16_to_ob_bits)."""
+    v = bits_to_int(bits) ^ 0x8000
+    return v - 0x10000 if v >= 0x8000 else v
+
+
 def pack_bits_lsb(bits: np.ndarray, axis: int = -1) -> np.ndarray:
     """Pack a bool array along ``axis`` (length <= 32) into uint32, bit j = bits[j]."""
     bits = np.moveaxis(np.asarray(bits, dtype=bool), axis, -1)

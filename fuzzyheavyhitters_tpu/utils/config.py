@@ -38,6 +38,7 @@ class Config:
     sketch_batch_size_last: int = 25_000
     backend: str = "tpu"
     secure_exchange: bool = False
+    f_max: int = 1024  # padded-frontier capacity (static shapes on device)
 
 
 def load_config(path: str) -> Config:

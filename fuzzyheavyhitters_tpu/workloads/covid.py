@@ -1,0 +1,102 @@
+"""COVID-geo workload: county-centroid sampler with spatial jitter
+(ref: src/sample_covid_data.rs).
+
+The reference streams a 9 GB case-surveillance CSV (absent from its own tree,
+``.MISSING_LARGE_BLOBS``), maps each case's county FIPS to a centroid, adds
+uniform jitter inside a km-side square, and emits each coordinate as the
+**64 IEEE-754 bits of the f64** MSB-first (``f64_to_bool_vec``,
+sample_covid_data.rs:32-35) — so its tree domain for this workload is the
+raw float bit pattern.  We reproduce the pipeline incl. that encoding quirk
+(with its lexicographic-ordering caveats inherited from upstream), and fall
+back to sampling counties uniformly when the big CSV is absent.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import struct
+
+import numpy as np
+
+
+def load_centroids(path: str) -> dict[str, tuple[float, float]]:
+    """FIPS -> (lat, lon) from county_centroids.csv
+    (ref: sample_covid_data.rs:17-30)."""
+    out = {}
+    with open(path, newline="") as f:
+        for row in csv.DictReader(f):
+            out[row["fips_code"]] = (float(row["latitude"]), float(row["longitude"]))
+    return out
+
+
+def f64_to_bool_vec(value: float) -> np.ndarray:
+    """IEEE-754 bits of an f64, MSB-first (ref: sample_covid_data.rs:32-35)."""
+    bits = struct.unpack(">Q", struct.pack(">d", value))[0]
+    return np.array([(bits >> (63 - i)) & 1 == 1 for i in range(64)], dtype=bool)
+
+
+def bool_vec_to_f64(bits) -> float:
+    v = 0
+    for b in np.asarray(bits, bool):
+        v = (v << 1) | int(b)
+    return struct.unpack(">d", struct.pack(">Q", v))[0]
+
+
+def uniform_in_square(
+    lat: float, lon: float, side_length_km: float, rng: np.random.Generator
+) -> tuple[float, float]:
+    """Uniform jitter in a km-side square at this latitude
+    (ref: sample_covid_data.rs:45-62)."""
+    km_per_deg_lat = 111.32
+    km_per_deg_lon = 111.32 * np.cos(np.radians(lat))
+    a_lat = (side_length_km / 2.0) / km_per_deg_lat
+    a_lon = (side_length_km / 2.0) / km_per_deg_lon
+    return (
+        float(np.clip(lat + rng.uniform(-a_lat, a_lat), -90.0, 90.0)),
+        float(np.clip(lon + rng.uniform(-a_lon, a_lon), -180.0, 180.0)),
+    )
+
+
+def sample_covid_locations(
+    covid_path: str,
+    centroids_path: str,
+    sample_size: int,
+    fuzz_factor: float | None = None,
+    seed: int | None = None,
+    fips_column: int = 5,
+) -> np.ndarray:
+    """bool[sample_size, 2, 64] jittered case locations as f64 bit vectors
+    (ref: sample_covid_data.rs:64-175).  When the case CSV is missing —
+    as in the reference's own tree — counties are sampled uniformly from the
+    centroid file instead (same output distribution family, no 9 GB input)."""
+    rng = np.random.default_rng(seed)
+    centroids = load_centroids(centroids_path)
+    fips_list = sorted(centroids)
+
+    coords = []
+    if os.path.exists(covid_path):
+        with open(covid_path, newline="") as f:
+            reader = csv.reader(f)
+            next(reader, None)
+            rows = []
+            for row in reader:
+                if len(row) > fips_column and row[fips_column].strip() in centroids:
+                    rows.append(row[fips_column].strip())
+        if len(rows) < sample_size:
+            raise ValueError(
+                f"Need {sample_size} valid samples but only found {len(rows)}"
+            )
+        take = rng.choice(len(rows), size=sample_size, replace=False)
+        coords = [centroids[rows[i]] for i in take]
+    else:
+        take = rng.choice(len(fips_list), size=sample_size, replace=True)
+        coords = [centroids[fips_list[i]] for i in take]
+
+    out = np.empty((sample_size, 2, 64), dtype=bool)
+    for i, (lat, lon) in enumerate(coords):
+        if fuzz_factor is not None:
+            lat, lon = uniform_in_square(lat, lon, fuzz_factor, rng)
+        out[i, 0] = f64_to_bool_vec(lat)
+        out[i, 1] = f64_to_bool_vec(lon)
+    return out

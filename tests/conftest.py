@@ -1,19 +1,41 @@
-"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+"""Test harness: real-chip default with an 8-device virtual CPU mesh beside it.
 
 Multi-chip sharding (the 2-server mesh axis plus client data-parallel axis)
 is exercised on virtual CPU devices, per the reference's in-process
 integration-test shape (two servers' state machines in one process,
-ref: tests/collect_test.rs).  Real-TPU paths are covered by bench.py.
+ref: tests/collect_test.rs).  Everything else runs on the session's default
+platform (the real TPU under axon; plain CPU elsewhere) — XLA:CPU both
+compiles our ChaCha scans pathologically slowly at full optimization and
+runs them slowly at reduced optimization, so the bulk of the suite stays on
+the accelerator and only the sharding tests pay the CPU cost.
+
+Mechanics: the session's sitecustomize imports JAX at interpreter start, so
+JAX_PLATFORMS edits here are too late; jax.config still works.  XLA_FLAGS is
+read lazily at first backend init, so the device-count and optimization
+flags do land.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
+    # optimization_level=1: XLA:CPU's default pipeline takes minutes to
+    # compile a lax.scan whose body contains the ChaCha expansion (253 s vs
+    # 1.4 s measured); level 1 sidesteps the pathological pass.  Applies
+    # only to the CPU backend (the TPU path compiles remotely).
     os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
+        xla_flags
+        + " --xla_force_host_platform_device_count=8"
+        + " --xla_backend_optimization_level=1"
     ).strip()
+
+import jax  # noqa: E402
+
+_plats = os.environ.get("JAX_PLATFORMS", "") or "cpu"
+if "cpu" not in _plats.split(","):
+    jax.config.update("jax_platforms", _plats + ",cpu")
+else:
+    jax.config.update("jax_platforms", _plats)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -22,3 +44,10 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices("cpu")
+    assert len(devs) == 8
+    return devs

@@ -1,0 +1,70 @@
+"""Multi-chip sharding tests on the virtual 8-device CPU mesh (conftest):
+the sharded 2×4 (servers × data) protocol must produce byte-identical heavy
+hitters to the in-process colocated driver."""
+
+import jax
+import numpy as np
+import pytest
+
+from fuzzyheavyhitters_tpu.ops import ibdcf
+from fuzzyheavyhitters_tpu.parallel import mesh as meshmod
+from fuzzyheavyhitters_tpu.protocol import driver
+from fuzzyheavyhitters_tpu.utils import bits as bitutils
+
+
+@pytest.fixture(scope="module")
+def client_batch():
+    rng = np.random.default_rng(7)
+    L, d, n = 6, 2, 32
+    centers = rng.integers(0, 1 << L, size=(3, d))
+    pts = centers[rng.integers(0, 3, size=n)] + rng.integers(-1, 2, size=(n, d))
+    pts = np.clip(pts, 0, (1 << L) - 1)
+    pts_bits = np.array(
+        [[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts]
+    )
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng)
+    return pts, k0, k1, L, d, n
+
+
+def _as_dict(res):
+    return {
+        tuple(int(v) for v in row): int(c)
+        for row, c in zip(res.decode_ints(), res.counts)
+    }
+
+
+def test_mesh_matches_colocated_driver(client_batch, cpu_devices):
+    pts, k0, k1, L, d, n = client_batch
+
+    s0, s1 = driver.make_servers(k0, k1)
+    lead = driver.Leader(s0, s1, n_dims=d, data_len=L, f_max=128)
+    want = _as_dict(lead.run(nreqs=n, threshold=0.1))
+    assert want  # non-degenerate scenario
+
+    m = meshmod.make_mesh(devices=cpu_devices)
+    assert m.shape == {"servers": 2, "data": 4}
+    runner = meshmod.MeshRunner(m, k0, k1, f_max=128)
+    got = _as_dict(meshmod.MeshLeader(runner).run(nreqs=n, threshold=0.1))
+    assert got == want
+
+
+def test_mesh_two_devices(client_batch, cpu_devices):
+    """Minimal mesh: just the 2-server axis, no data parallelism — the
+    2-chip deployment shape from BASELINE.md's north star."""
+    pts, k0, k1, L, d, n = client_batch
+    m = meshmod.make_mesh(devices=cpu_devices[:2])
+    runner = meshmod.MeshRunner(m, k0, k1, f_max=128)
+    got = _as_dict(meshmod.MeshLeader(runner).run(nreqs=n, threshold=0.1))
+
+    s0, s1 = driver.make_servers(k0, k1)
+    want = _as_dict(
+        driver.Leader(s0, s1, n_dims=d, data_len=L, f_max=128).run(
+            nreqs=n, threshold=0.1
+        )
+    )
+    assert got == want
+
+
+def test_odd_device_count_rejected(cpu_devices):
+    with pytest.raises(AssertionError, match="even"):
+        meshmod.make_mesh(devices=cpu_devices[:3])

@@ -1,0 +1,107 @@
+"""End-to-end protocol tests: in-process leader + two colocated servers vs a
+brute-force heavy-hitters oracle (the integration-test shape of the
+reference's collect_test.rs: known multiset in, exact counts out —
+SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from fuzzyheavyhitters_tpu.ops import ibdcf
+from fuzzyheavyhitters_tpu.protocol import collect, driver
+from fuzzyheavyhitters_tpu.utils import bits as bitutils
+
+
+def brute_force_hitters(pts, ball, L, thresh):
+    """All leaves x where #{clients whose saturated L∞ ball contains x} >=
+    thresh, with exact counts.  pts: int[N, d]."""
+    pts = np.asarray(pts)
+    n, d = pts.shape
+    lo = np.clip(pts - ball, 0, (1 << L) - 1)
+    hi = np.clip(pts + ball, 0, (1 << L) - 1)
+    out = {}
+    grid = np.stack(
+        np.meshgrid(*[np.arange(1 << L)] * d, indexing="ij"), axis=-1
+    ).reshape(-1, d)
+    for x in grid:
+        c = int(np.sum(np.all((x >= lo) & (x <= hi), axis=1)))
+        if c >= thresh:
+            out[tuple(int(v) for v in x)] = c
+    return out
+
+
+def run_protocol(pts, ball, L, threshold, f_max=512):
+    pts = np.asarray(pts)
+    n, d = pts.shape
+    rng = np.random.default_rng(99)
+    pts_bits = np.array(
+        [[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts]
+    )
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, ball, rng)
+    s0, s1 = driver.make_servers(k0, k1)
+    lead = driver.Leader(s0, s1, n_dims=d, data_len=L, f_max=f_max)
+    res = lead.run(nreqs=n, threshold=threshold)
+    got = {}
+    for i in range(res.paths.shape[0]):
+        key = tuple(int(v) for v in res.decode_ints()[i])
+        got[key] = int(res.counts[i])
+    return got
+
+
+@pytest.mark.parametrize("d,L,ball", [(1, 6, 3), (2, 5, 2)])
+def test_heavy_hitters_match_brute_force(rng, d, L, ball):
+    n = 40
+    # clustered points so some leaves clear the threshold
+    centers = rng.integers(0, 1 << L, size=(4, d))
+    pts = centers[rng.integers(0, 4, size=n)] + rng.integers(-1, 2, size=(n, d))
+    pts = np.clip(pts, 0, (1 << L) - 1)
+    threshold = 0.1  # thresh = max(1, 4)
+    got = run_protocol(pts, ball, L, threshold)
+    want = brute_force_hitters(pts, ball, L, max(1, int(threshold * n)))
+    assert got == want
+
+
+def test_no_survivors_early_exit(rng):
+    pts = np.array([[3], [10], [40]])
+    got = run_protocol(pts, 1, 6, threshold=0.99)  # thresh=2, balls disjoint
+    assert got == {}
+
+
+def test_single_client_threshold_one(rng):
+    """threshold*nreqs < 1 floors to 1 (ref: leader.rs:193)."""
+    pts = np.array([[17]])
+    got = run_protocol(pts, 2, 6, threshold=0.0001)
+    want = brute_force_hitters(pts, 2, 6, 1)
+    assert got == want
+
+
+def test_liveness_flag_gates_counts(rng):
+    """Disabling a client's liveness flag removes it from every count
+    (ref: collect.rs:495 — the hook the sketch verification uses)."""
+    pts = np.array([[8], [8], [8], [50]])
+    L, ball = 6, 1
+    pts_bits = np.array([[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts])
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, ball, np.random.default_rng(5))
+    s0, s1 = driver.make_servers(k0, k1)
+    s0.alive_keys[0] = False
+    s1.alive_keys[0] = False
+    lead = driver.Leader(s0, s1, n_dims=1, data_len=L, f_max=128)
+    res = lead.run(nreqs=4, threshold=0.5)  # thresh=2
+    got = {tuple(r): c for r, c in zip(res.decode_ints(), res.counts)}
+    # only two live clients at 8 remain above threshold
+    assert set(got) == {(7,), (8,), (9,)}
+    assert all(c == 2 for c in got.values())
+
+
+def test_f_max_overflow_raises(rng):
+    pts = np.tile(np.arange(0, 64, 2)[:, None], (1, 1))  # 32 spread clients
+    with pytest.raises(ValueError, match="f_max"):
+        run_protocol(pts, 3, 6, threshold=0.001, f_max=4)
+
+
+def test_pattern_masks_layout():
+    m = collect.pattern_masks(2)
+    assert m.shape == (4,)
+    # pattern 0: dirs (0,0) -> bits at (j*4 + s*2 + 0)
+    assert m[0] == sum(1 << (j * 4 + s * 2) for j in range(2) for s in range(2))
+    # pattern 3: dirs (1,1)
+    assert m[3] == sum(1 << (j * 4 + s * 2 + 1) for j in range(2) for s in range(2))

@@ -1,0 +1,117 @@
+"""Control-plane tests: both collector servers + leader in one asyncio loop
+(the reference's in-process duplex-socket 2PC test pattern,
+ref: equalitytest.rs:222-266) — full 8-verb protocol over real TCP on
+localhost, counts reconstructed from field-element shares."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from fuzzyheavyhitters_tpu.ops import ibdcf
+from fuzzyheavyhitters_tpu.protocol import driver, rpc
+from fuzzyheavyhitters_tpu.protocol.leader_rpc import RpcLeader
+from fuzzyheavyhitters_tpu.utils import bits as bitutils
+from fuzzyheavyhitters_tpu.utils.config import Config
+
+BASE_PORT = 39131
+
+
+def _cfg(**kw):
+    defaults = dict(
+        data_len=6,
+        n_dims=1,
+        ball_size=2,
+        addkey_batch_size=8,
+        num_sites=4,
+        threshold=0.1,
+        zipf_exponent=1.03,
+        server0="127.0.0.1:39131",
+        server1="127.0.0.1:39141",
+        distribution="zipf",
+        f_max=128,
+    )
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+async def _run_protocol(cfg, keys0, keys1, nreqs, port0, port1):
+    s0 = rpc.CollectorServer(0, cfg)
+    s1 = rpc.CollectorServer(1, cfg)
+    peer_port = port1 + 1
+    # server1 first (it listens on the data plane), then server0 dials —
+    # the reference's startup ordering constraint (server.rs:344-354)
+    t1 = asyncio.create_task(s1.start("127.0.0.1", port1, "127.0.0.1", peer_port))
+    await asyncio.sleep(0.05)
+    t0 = asyncio.create_task(s0.start("127.0.0.1", port0, "127.0.0.1", peer_port))
+    c0 = await rpc.CollectorClient.connect("127.0.0.1", port0)
+    c1 = await rpc.CollectorClient.connect("127.0.0.1", port1)
+    await asyncio.gather(t0, t1)
+
+    lead = RpcLeader(cfg, c0, c1)
+    await asyncio.gather(c0.call("reset"), c1.call("reset"))
+    await lead.upload_keys(keys0, keys1)
+    return await lead.run(nreqs)
+
+
+def test_rpc_protocol_matches_colocated(rng):
+    L, d, n = 6, 1, 24
+    cfg = _cfg(data_len=L, n_dims=d)
+    pts = np.concatenate([np.full(16, 20), rng.integers(0, 1 << L, size=8)])[:, None]
+    pts_bits = np.array([[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts])
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, cfg.ball_size, rng)
+
+    res = asyncio.run(_run_protocol(cfg, k0, k1, n, BASE_PORT, BASE_PORT + 10))
+    got = {
+        tuple(int(v) for v in r): int(c)
+        for r, c in zip(res.decode_ints(), res.counts)
+    }
+
+    s0, s1 = driver.make_servers(k0, k1)
+    lead = driver.Leader(s0, s1, n_dims=d, data_len=L, f_max=cfg.f_max)
+    want_res = lead.run(nreqs=n, threshold=cfg.threshold)
+    want = {
+        tuple(int(v) for v in r): int(c)
+        for r, c in zip(want_res.decode_ints(), want_res.counts)
+    }
+    assert got == want
+    assert got  # the 16 stacked clients at 20 must clear the threshold
+
+
+def test_share_masks_cancel():
+    """Server0's and server1's mask streams are identical, so shares
+    reconstruct exactly (the shared-seed trick, ref: server.rs:331-332)."""
+    from fuzzyheavyhitters_tpu.ops.fields import F255, FE62
+
+    r0 = rpc.mask_fe62(3, 10)
+    r1 = rpc.mask_fe62(3, 10)
+    np.testing.assert_array_equal(r0, r1)
+    assert not np.array_equal(r0, rpc.mask_fe62(4, 10))  # level-keyed
+    counts = np.arange(10).astype(np.uint64)
+    rec = np.asarray(FE62.canon(FE62.sub(FE62.add(counts, r0), r1)))
+    np.testing.assert_array_equal(rec, counts)
+
+    m0 = rpc.mask_f255(2, 6)
+    c = np.zeros((6, 8), np.uint32)
+    c[:, 0] = np.arange(6)
+    rec = np.asarray(F255.sub(F255.add(c, m0), rpc.mask_f255(2, 6)))
+    np.testing.assert_array_equal(rec[:, 0], np.arange(6))
+    assert not rec[:, 1:].any()
+
+
+def test_reset_clears_state(rng):
+    """reset → add_keys → tree_init works twice (ref: server.rs:64-69)."""
+
+    async def flow():
+        cfg = _cfg()
+        s0 = rpc.CollectorServer(0, cfg)
+        pts_bits = np.array([[bitutils.int_to_bits(6, 20)]])
+        k0, _ = ibdcf.gen_l_inf_ball(pts_bits, 1, rng)
+        for _ in range(2):
+            await s0.reset({})
+            await s0.add_keys({"keys": tuple(np.asarray(x) for x in k0)})
+            await s0.tree_init({})
+            assert s0.keys.cw_seed.shape[0] == 1
+        return True
+
+    assert asyncio.run(flow())

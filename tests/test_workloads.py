@@ -1,0 +1,134 @@
+"""Workload-layer tests (host-only: samplers, codecs, writers)."""
+
+import csv
+import numpy as np
+import pytest
+
+from fuzzyheavyhitters_tpu.workloads import covid, rides, strings
+from fuzzyheavyhitters_tpu.utils import bits as bitutils
+
+
+def test_sample_string_bits_shape_and_ascii(rng):
+    bits = strings.sample_string_bits(rng, 56)
+    assert bits.shape == (56,) and bits.dtype == bool
+    # bytes decode back to alphanumeric ASCII (ref: leader.rs:38-44)
+    by = np.packbits(bits.astype(np.uint8), bitorder="little").tobytes()
+    assert by.decode("ascii").isalnum()
+
+
+def test_zipf_is_skewed(rng):
+    idx = strings.zipf_indices(rng, num_sites=50, exponent=1.03, nreqs=5000)
+    assert idx.min() >= 0 and idx.max() < 50
+    counts = np.bincount(idx, minlength=50)
+    assert counts[0] > counts[10] > counts[40]  # heavy head
+
+
+def test_zipf_workload_shapes(rng):
+    pts, idx = strings.zipf_workload(
+        rng, num_sites=10, data_len=32, n_dims=2, zipf_exponent=1.1, nreqs=20
+    )
+    assert pts.shape == (20, 2, 32)
+    assert idx.shape == (20,)
+    # same-site requests share the site prefix, differ (whp) in augmentation
+    same = np.nonzero(idx == idx[0])[0]
+    if len(same) > 1:
+        a, b = pts[same[0]], pts[same[1]]
+        assert np.array_equal(a[:, :24], b[:, :24])
+
+
+def test_geo_codec_roundtrip_austin():
+    """(ref: sample_driving_data.rs:149-163 test_austin_coords)"""
+    lat, lon = 30.26, -97.74
+    lat_i, lon_i = rides.geo_to_int(lat, lon)
+    assert (lat_i, lon_i) == (3026, -9774)
+    assert rides.int_to_geo(lat_i, lon_i) == (lat, lon)
+
+
+def test_rides_csv_sampler(tmp_path, rng):
+    path = tmp_path / "rides.csv"
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow([f"col{i}" for i in range(16)])
+        for i in range(20):
+            row = [""] * 16
+            row[14] = str(30.0 + i / 100)  # start lat
+            row[13] = str(-97.7 - i / 100)  # start lon
+            w.writerow(row)
+    pts = rides.sample_start_locations(str(path), 5, seed=3)
+    assert pts.shape == (5, 2) and pts.dtype == np.int16
+    assert np.all((pts[:, 0] >= 3000) & (pts[:, 0] <= 3020))
+    assert np.all(pts[:, 1] <= -9770)
+
+
+def test_synthetic_austin_fallback(tmp_path):
+    pts = rides.load_or_synthesize_locations(str(tmp_path / "nope.csv"), 100, seed=1)
+    assert pts.shape == (100, 2)
+    # clustered near Austin
+    assert abs(int(np.median(pts[:, 0])) - 3026) < 200
+    assert abs(int(np.median(pts[:, 1])) + 9774) < 200
+
+
+def test_save_heavy_hitters_roundtrip(tmp_path):
+    coords = np.array([[3026, -9774], [3030, -9770]], dtype=np.int16)
+    paths = np.stack(
+        [
+            np.stack([bitutils.i16_to_ob_bits(int(v)) for v in row])
+            for row in coords
+        ]
+    )
+    out = tmp_path / "hh.csv"
+    rides.save_heavy_hitters(paths, str(out))
+    rides.save_heavy_hitters(paths, str(out))  # append mode, single header
+    with open(out) as f:
+        lines = list(csv.reader(f))
+    assert lines[0] == ["index", "latitude", "longitude"]
+    assert len(lines) == 5
+    assert [float(lines[1][1]), float(lines[1][2])] == [30.26, -97.74]
+
+
+@pytest.fixture
+def centroids_csv(tmp_path):
+    path = tmp_path / "county_centroids.csv"
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["fips_code", "latitude", "longitude"])
+        w.writerow(["48453", "30.33", "-97.78"])  # Travis County
+        w.writerow(["06037", "34.31", "-118.23"])  # LA County
+        w.writerow(["17031", "41.84", "-87.82"])  # Cook County
+    return str(path)
+
+
+def test_covid_sampler_fallback(centroids_csv, tmp_path):
+    out = covid.sample_covid_locations(
+        str(tmp_path / "absent.csv"), centroids_csv, 50, fuzz_factor=5.0, seed=9
+    )
+    assert out.shape == (50, 2, 64)
+    lats = [covid.bool_vec_to_f64(out[i, 0]) for i in range(50)]
+    lons = [covid.bool_vec_to_f64(out[i, 1]) for i in range(50)]
+    # jittered but near one of the three centroids
+    for lat, lon in zip(lats, lons):
+        d = min(
+            abs(lat - 30.33) + abs(lon + 97.78),
+            abs(lat - 34.31) + abs(lon + 118.23),
+            abs(lat - 41.84) + abs(lon + 87.82),
+        )
+        assert d < 0.2
+
+
+def test_covid_sampler_with_case_csv(centroids_csv, tmp_path):
+    case = tmp_path / "cases.csv"
+    with open(case, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow([f"c{i}" for i in range(8)])
+        for _ in range(30):
+            row = [""] * 8
+            row[5] = "48453"
+            w.writerow(row)
+    out = covid.sample_covid_locations(str(case), centroids_csv, 10, seed=2)
+    assert out.shape == (10, 2, 64)
+    assert covid.bool_vec_to_f64(out[0, 0]) == 30.33  # no fuzz -> exact centroid
+
+
+def test_f64_bits_roundtrip():
+    for v in (0.0, -97.74, 30.26, 1e-12, float(np.pi)):
+        assert covid.bool_vec_to_f64(covid.f64_to_bool_vec(v)) == v
