@@ -100,12 +100,50 @@ class FE62:
         return cls._bit_reduce(r + h1)
 
     @classmethod
+    def pow_const(cls, a, e: int):
+        """a^e for a Python-int exponent: square-and-multiply as a
+        ``lax.scan`` over the exponent bits (LSB-first), so the compiled
+        graph is one square + one select-multiply regardless of exponent
+        size — an unrolled chain at 255-bit exponents is a ~10^5-op graph
+        that the TPU compiler cannot digest."""
+        return _pow_scan(cls, jnp.asarray(a, jnp.uint64), e)
+
+    @classmethod
+    def recip(cls, a):
+        """Multiplicative inverse by Fermat: a^(p-2)  (ref: fastfield.rs:154
+        ``recip`` — same exponentiation-by-squaring construction).
+        recip(0) = 0 (garbage-in convention, as in the reference)."""
+        return cls.pow_const(a, cls.P - 2)
+
+    @classmethod
     def ge(cls, a, b):
         return cls.canon(a) >= cls.canon(b)
 
     @classmethod
     def eq(cls, a, b):
         return cls.canon(a) == cls.canon(b)
+
+    # -- Block codec (OT payloads travel as 128-bit blocks; ref:
+    # fastfield.rs:414-431 Block (de)serialization) ----------------------
+
+    @classmethod
+    def to_blocks(cls, v) -> "jax.Array":
+        """[...] canonical values -> uint32[..., 4] little-endian blocks."""
+        v = cls.canon(v)
+        lo = (v & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = (v >> 32).astype(jnp.uint32)
+        zeros = jnp.zeros_like(lo)
+        return jnp.stack([lo, hi, zeros, zeros], axis=-1)
+
+    @classmethod
+    def from_blocks(cls, blocks) -> "jax.Array":
+        """uint32[..., 4] blocks -> field values (upper words ignored mod p)."""
+        blocks = jnp.asarray(blocks, jnp.uint64)
+        lo = blocks[..., 0] | (blocks[..., 1] << 32)
+        hi = blocks[..., 2] | (blocks[..., 3] << 32)
+        return cls.add(
+            cls._bit_reduce(lo), cls.mul(cls.new(hi), cls.from_int((1 << 64) % cls.P))
+        )
 
     @classmethod
     def sample(cls, words):
@@ -224,6 +262,69 @@ class F255:
         return cls.add(a, cls.neg(b))
 
     @classmethod
+    def mul(cls, a, b):
+        """Schoolbook 8x8 limb product + 2^256 === 38 folding (ref:
+        field.rs:339-343 ``mul`` over BigUint; here a fixed-width carry
+        network of u64 ops only, no bignums, XLA-friendly).
+
+        Column sums split each 64-bit partial product into lo/hi words so no
+        intermediate exceeds u64 (max 8 terms of < 2^32 each per column).
+        """
+        a64 = jnp.asarray(a, jnp.uint32).astype(jnp.uint64)
+        b64 = jnp.asarray(b, jnp.uint32).astype(jnp.uint64)
+        mask32 = jnp.uint64(0xFFFFFFFF)
+        batch = jnp.broadcast_shapes(a64.shape[:-1], b64.shape[:-1])
+        cols_lo = [jnp.zeros(batch, jnp.uint64) for _ in range(17)]
+        cols_hi = [jnp.zeros(batch, jnp.uint64) for _ in range(17)]
+        for i in range(8):
+            for j in range(8):
+                p = a64[..., i] * b64[..., j]
+                k = i + j
+                cols_lo[k] = cols_lo[k] + (p & mask32)
+                cols_hi[k + 1] = cols_hi[k + 1] + (p >> 32)
+        # carry-propagate into 16 product limbs (value < 2^512)
+        limbs16 = []
+        carry = jnp.zeros(batch, jnp.uint64)
+        for k in range(16):
+            s = cols_lo[k] + cols_hi[k] + carry
+            limbs16.append(s & mask32)
+            carry = s >> 32
+        # fold: product = L + 2^256*H === L + 38*H (mod p)
+        out = []
+        carry = jnp.zeros(batch, jnp.uint64)
+        for k in range(8):
+            s = limbs16[k] + limbs16[k + 8] * jnp.uint64(38) + carry
+            out.append(s & mask32)
+            carry = s >> 32
+        # carry < 103; fold 38*carry back in, twice: the first re-fold can
+        # itself overflow 2^256 only when the value was within 38*103 of it,
+        # leaving a wrapped value < 4000 — so the second re-fold cannot carry.
+        for _ in range(2):
+            c2 = carry * jnp.uint64(38)
+            limbs = []
+            for k in range(8):
+                s = out[k] + c2
+                limbs.append(s & mask32)
+                c2 = s >> 32
+            out, carry = limbs, c2
+        r = jnp.stack(out, axis=-1).astype(jnp.uint32)
+        r = cls._sub_p_if(r, cls._geq_p(r))
+        return cls._sub_p_if(r, cls._geq_p(r))
+
+    @classmethod
+    def pow_const(cls, a, e: int):
+        """a^e for a Python-int exponent (scan over exponent bits, see
+        FE62.pow_const for why scan rather than unrolling)."""
+        return _pow_scan(cls, jnp.asarray(a, jnp.uint32), e)
+
+    @classmethod
+    def recip(cls, a):
+        """Multiplicative inverse by Fermat: a^(p-2); recip(0) = 0.  The
+        reference's FieldElm has no inverse (field.rs) — added here for the
+        sketch/MPC layer's field-law completeness."""
+        return cls.pow_const(a, cls.P - 2)
+
+    @classmethod
     def canon(cls, a):
         return a
 
@@ -274,13 +375,178 @@ class F255:
         )
         return out.reshape(limbs.shape[:-1])
 
+    # -- BlockPair codec (ref: field.rs:465-492 — F255 OT payloads travel
+    # as two 128-bit blocks) ---------------------------------------------
+
+    @classmethod
+    def to_blocks(cls, v) -> "jax.Array":
+        """[..., 8] limbs -> uint32[..., 2, 4] block pairs (low block first,
+        little-endian words — our canonical layout; the reference uses
+        big-endian bytes, a serialization detail with no protocol effect)."""
+        v = jnp.asarray(v, jnp.uint32)
+        return v.reshape(v.shape[:-1] + (2, 4))
+
+    @classmethod
+    def from_blocks(cls, blocks) -> "jax.Array":
+        """uint32[..., 2, 4] block pairs -> [..., 8] limbs (mod-p folded)."""
+        blocks = jnp.asarray(blocks, jnp.uint32)
+        limbs = blocks.reshape(blocks.shape[:-2] + (8,))
+        limbs = cls._sub_p_if(limbs, cls._geq_p(limbs))
+        return cls._sub_p_if(limbs, cls._geq_p(limbs))
+
+
+def _pow_scan(field, a, e: int):
+    """Shared square-and-multiply scan over the bits of a Python int."""
+    if e == 0:
+        one = field.from_int(1)
+        return jnp.broadcast_to(one, a.shape[: a.ndim - len(field.limb_shape)] + one.shape)
+    bits = jnp.asarray([(e >> i) & 1 for i in range(e.bit_length())], bool)
+    one = jnp.broadcast_to(
+        field.from_int(1), a.shape[: a.ndim - len(field.limb_shape)] + field.limb_shape
+    ).astype(a.dtype)
+
+    def step(carry, bit):
+        result, base = carry
+        taken = field.mul(result, base)
+        result = jnp.where(bit, taken, result)
+        return (result, field.mul(base, base)), None
+
+    (result, _), _ = jax.lax.scan(step, (one, a), bits)
+    return result
+
+
+_P63 = (1 << 63) - 25
+_M63 = (1 << 63) - 1
+
+
+class U63:
+    """p = 2^63 - 25 on uint64, canonical values — the reference's ``Group``
+    impl for u64 (ref: field.rs:25-26, 128-188: MODULUS_64 = 2^63 - 25)."""
+
+    P = _P63
+    dtype = jnp.uint64
+    limb_shape = ()
+
+    @staticmethod
+    def _reduce63(v):
+        # 2^63 === 25 (mod p); one bit of excess folds in 25 at a time
+        return (v & jnp.uint64(_M63)) + jnp.uint64(25) * (v >> 63)
+
+    @classmethod
+    def canon(cls, v):
+        v = cls._reduce63(cls._reduce63(v))
+        return jnp.where(v >= cls.P, v - cls.P, v)
+
+    @classmethod
+    def zeros(cls, shape):
+        return jnp.zeros(shape, jnp.uint64)
+
+    @classmethod
+    def from_int(cls, x: int):
+        return jnp.asarray(x % cls.P, jnp.uint64)
+
+    @classmethod
+    def add(cls, a, b):
+        # canonical inputs sum below 2^64; settle back to canonical
+        return cls.canon(jnp.asarray(a, jnp.uint64) + jnp.asarray(b, jnp.uint64))
+
+    @classmethod
+    def neg(cls, a):
+        return cls.canon(jnp.uint64(cls.P) - jnp.asarray(a, jnp.uint64))
+
+    @classmethod
+    def sub(cls, a, b):
+        return cls.add(a, cls.neg(b))
+
+    @classmethod
+    def mul(cls, a, b):
+        """126-bit product via 32-bit split, folded with 2^64 === 50."""
+        a = cls.canon(jnp.asarray(a, jnp.uint64))
+        b = cls.canon(jnp.asarray(b, jnp.uint64))
+        mask32 = jnp.uint64(0xFFFFFFFF)
+        a0, a1 = a & mask32, a >> 32  # a1 < 2^31
+        b0, b1 = b & mask32, b >> 32
+        t0 = a0 * b0
+        t1 = a0 * b1 + a1 * b0  # < 2^64 - 2^33
+        t2 = a1 * b1  # < 2^62
+        t1 = t1 + (t0 >> 32)
+        t2 = t2 + (t1 >> 32)
+        t_low = (t0 & mask32) | ((t1 & mask32) << 32)  # product mod 2^64
+        # product = t_low + t2*2^64 === t_low + 50*t2; decompose t2 to stay
+        # in u64: 50*t2 = 50*t2l + (50*t2h mod p)*2^32-ish chains below
+        t2l, t2h = t2 & mask32, t2 >> 32  # t2h < 2^30
+        u = jnp.uint64(50) * t2h  # < 2^36
+        ul, uh = u & mask32, u >> 32  # uh < 2^4
+        r = cls._reduce63(cls._reduce63(t_low))
+        r = cls.add(r, cls.canon(jnp.uint64(50) * t2l))
+        r = cls.add(r, cls.canon(ul << 32))
+        return cls.add(r, jnp.uint64(50) * uh)
+
+    @classmethod
+    def eq(cls, a, b):
+        return cls.canon(a) == cls.canon(b)
+
+    @classmethod
+    def sample(cls, words):
+        """uniform uint32[..., 4] -> near-uniform field elements (shaped
+        device sampling; the reference rejection-samples host-side,
+        field.rs:168-175)."""
+        words = jnp.asarray(words, jnp.uint64)
+        lo = (words[..., 0] | (words[..., 1] << 32)) & jnp.uint64(_M63)
+        hi = words[..., 2] | (words[..., 3] << 32)
+        return cls.add(cls._reduce63(lo), cls.mul(cls.canon(hi), cls.from_int(1 << 32)))
+
+    @classmethod
+    def sum(cls, v, *, axis):
+        v = cls.canon(jnp.asarray(v, jnp.uint64))
+        mask32 = jnp.uint64(0xFFFFFFFF)
+        lo = jnp.sum(v & mask32, axis=axis)
+        hi = jnp.sum(v >> 32, axis=axis)
+        return cls.add(cls.canon(lo), cls.mul(cls.canon(hi), cls.from_int(1 << 32)))
+
+    @classmethod
+    def to_numpy_ints(cls, v) -> np.ndarray:
+        return np.asarray(jax.jit(cls.canon)(v), dtype=np.uint64)
+
+
+class Dummy:
+    """The reference's no-op group (ref: field.rs:44-126): every op returns
+    zero; used to stub a field slot out of a generic protocol."""
+
+    P = 1
+    dtype = jnp.uint32
+    limb_shape = ()
+
+    zeros = staticmethod(lambda shape: jnp.zeros(shape, jnp.uint32))
+    from_int = staticmethod(lambda x: jnp.uint32(0))
+    canon = staticmethod(lambda v: jnp.zeros_like(v))
+    add = staticmethod(lambda a, b: jnp.zeros_like(a))
+    sub = staticmethod(lambda a, b: jnp.zeros_like(a))
+    neg = staticmethod(lambda a: jnp.zeros_like(a))
+    mul = staticmethod(lambda a, b: jnp.zeros_like(a))
+    eq = staticmethod(lambda a, b: jnp.ones(jnp.asarray(a).shape, bool))
+    sample = staticmethod(lambda words: jnp.zeros(jnp.asarray(words).shape[:-1], jnp.uint32))
+
+    @staticmethod
+    def sum(v, *, axis):
+        return jnp.zeros(tuple(np.delete(np.asarray(v.shape), axis)), jnp.uint32)
+
 
 def _jit_field_methods():
     """Jit the eager entry points once per class; composing jitted calls inside
     a larger jit still inlines and fuses (XLA treats them as nested calls)."""
     for klass, names in (
-        (FE62, ["new", "canon", "add", "neg", "sub", "mul", "ge", "eq", "sample"]),
-        (F255, ["add", "neg", "sub", "ge", "eq", "sample"]),
+        (
+            FE62,
+            ["new", "canon", "add", "neg", "sub", "mul", "recip", "ge", "eq",
+             "sample", "to_blocks", "from_blocks"],
+        ),
+        (
+            F255,
+            ["add", "neg", "sub", "mul", "recip", "ge", "eq", "sample",
+             "to_blocks", "from_blocks"],
+        ),
+        (U63, ["canon", "add", "neg", "sub", "mul", "eq", "sample"]),
     ):
         for name in names:
             setattr(klass, name, staticmethod(jax.jit(getattr(klass, name))))

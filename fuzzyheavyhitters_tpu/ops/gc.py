@@ -1,0 +1,196 @@
+"""Garbled-circuit equality tests as batched TPU tensor kernels.
+
+The reference garbles per-string equality circuits with the swanky
+``fancy-garbling`` stack over per-core TCP channels (ref:
+src/equalitytest.rs:25-191, driven from src/collect.rs:419-437).  Its
+circuit is bitwise XNOR + an AND-tree, with the garbler XOR-masking each
+result by a random bit so the output is XOR-shared between the parties
+(equalitytest.rs:38-43, 148-161).
+
+TPU-native redesign — nothing is per-gate or per-wire at runtime; a whole
+batch of B equality tests over S-bit strings garbles/evaluates as a handful
+of fused tensor ops:
+
+- **Wire labels** are 128-bit blocks ``uint32[..., 4]`` drawn from the
+  ChaCha stream (ops/prg.py) — the same substrate the reference's AES-128
+  labels live on.
+- **Free-XOR** (Kolesnikov-Schneider): a global offset ``R`` with
+  ``lsb(R)=1``; XOR and NOT gates cost nothing.  XNOR(x_i, y_i) is the
+  free relabeling ``Z0_i = X0_i ^ Y0_i ^ R``.
+- **Half-gates AND** (Zahur-Rosulek-Evans 2015): two ciphertexts per AND
+  gate, hashed with the fixed-key ChaCha block function as the
+  correlation-robust hash ``H(label, tweak)`` — the TPU analogue of the
+  fixed-key-AES garbling hash.  The S-leaf AND-tree runs as ``ceil(log2 S)``
+  *batched* gate layers.
+- **Masked output**: instead of feeding the garbler's mask bit as an extra
+  circuit input wire (the reference's extra wire per test,
+  equalitytest.rs:38-43, 153-160), the mask folds into the output decode
+  bit — identical XOR-share semantics, zero extra gates.
+
+The evaluator receives the garbler's input labels directly and its own via
+OT (ops/otext.py), exactly the reference's wire-exchange split
+(equalitytest.rs:68-82, 109-125).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import prg
+
+LABEL_WORDS = 4  # 128-bit labels
+
+# hash-tweak constants (words 2/3 of the tweak block): arbitrary fixed
+# odd constants so GC hashing never collides with the PRG's other uses
+_TWEAK2 = 0x9E3779B9
+_TWEAK3 = 0x7F4A7C15
+
+
+def _hash(label: jax.Array, gate_ids: jax.Array, half: int) -> jax.Array:
+    """Correlation-robust hash H(label, tweak) -> uint32[..., 4].
+
+    tweak = (gate id, half-gate selector, const, const) XORed into the
+    label block before the fixed-key ChaCha permutation; the feed-forward
+    add makes the map non-invertible (the Davies-Meyer role, as in
+    fixed-key-AES garbling).
+    """
+    tweak = jnp.stack(
+        [
+            jnp.asarray(gate_ids, jnp.uint32),
+            jnp.full_like(jnp.asarray(gate_ids, jnp.uint32), half),
+            jnp.full_like(jnp.asarray(gate_ids, jnp.uint32), _TWEAK2),
+            jnp.full_like(jnp.asarray(gate_ids, jnp.uint32), _TWEAK3),
+        ],
+        axis=-1,
+    )
+    return prg.chacha_block(label ^ tweak)[..., :4]
+
+
+def _maskw(bit: jax.Array, block: jax.Array) -> jax.Array:
+    """bit ? block : 0, broadcasting bit over the trailing word axis."""
+    return jnp.where(bit[..., None], block, jnp.zeros_like(block))
+
+
+def _lsb(label: jax.Array) -> jax.Array:
+    return (label[..., 0] & 1).astype(bool)
+
+
+class GarbledEqBatch(NamedTuple):
+    """Everything the evaluator needs except its own input labels.
+
+    tables:    uint32[B, S-1, 2, 4] — (T_G, T_E) per AND gate, tree order;
+    gb_labels: uint32[B, S, 4]      — the garbler's active input labels;
+    decode:    bool[B]              — output decode bit, pre-XORed with the
+                                      garbler's random mask (share 0).
+    """
+
+    tables: jax.Array
+    gb_labels: jax.Array
+    decode: jax.Array
+
+
+class GarblerSecrets(NamedTuple):
+    """Garbler-side secrets: its output share + the evaluator label pairs
+    to feed the label OT (choice bit = evaluator's input bit)."""
+
+    mask: jax.Array  # bool[B] — garbler's XOR share of each result
+    ev_label0: jax.Array  # uint32[B, S, 4] — labels for y_i = 0
+    ev_label1: jax.Array  # uint32[B, S, 4] — labels for y_i = 1
+
+
+def _and_tree_garble(wires0, R):
+    """AND-reduce zero-labels [B, S, 4] -> ([B, 4], tables [B, S-1, 2, 4])."""
+    tables = []
+    gate = 0
+    while wires0.shape[-2] > 1:
+        k = wires0.shape[-2] // 2
+        A0 = wires0[..., 0 : 2 * k : 2, :]
+        B0 = wires0[..., 1 : 2 * k : 2, :]
+        gids = jnp.arange(gate, gate + k, dtype=jnp.uint32)
+        pa, pb = _lsb(A0), _lsb(B0)
+        HA0 = _hash(A0, gids, 0)
+        HA1 = _hash(A0 ^ R[..., None, :], gids, 0)
+        TG = HA0 ^ HA1 ^ _maskw(pb, R[..., None, :])
+        WG = HA0 ^ _maskw(pa, TG)
+        HB0 = _hash(B0, gids, 1)
+        HB1 = _hash(B0 ^ R[..., None, :], gids, 1)
+        TE = HB0 ^ HB1 ^ A0
+        WE = HB0 ^ _maskw(pb, TE ^ A0)
+        C0 = WG ^ WE
+        tables.append(jnp.stack([TG, TE], axis=-2))  # [B, k, 2, 4]
+        gate += k
+        wires0 = jnp.concatenate([C0, wires0[..., 2 * k :, :]], axis=-2)
+    if not tables:  # S == 1: a bare XNOR, no AND gates
+        tables = [jnp.zeros(wires0.shape[:-2] + (0, 2, 4), jnp.uint32)]
+    return wires0[..., 0, :], jnp.concatenate(tables, axis=-3)
+
+
+def _and_tree_eval(wires, tables):
+    """Evaluator twin of :func:`_and_tree_garble` on active labels."""
+    gate = 0
+    while wires.shape[-2] > 1:
+        k = wires.shape[-2] // 2
+        A = wires[..., 0 : 2 * k : 2, :]
+        B = wires[..., 1 : 2 * k : 2, :]
+        gids = jnp.arange(gate, gate + k, dtype=jnp.uint32)
+        TG = tables[..., gate : gate + k, 0, :]
+        TE = tables[..., gate : gate + k, 1, :]
+        WG = _hash(A, gids, 0) ^ _maskw(_lsb(A), TG)
+        WE = _hash(B, gids, 1) ^ _maskw(_lsb(B), TE ^ A)
+        C = WG ^ WE
+        gate += k
+        wires = jnp.concatenate([C, wires[..., 2 * k :, :]], axis=-2)
+    return wires[..., 0, :]
+
+
+@jax.jit
+def garble_equality(
+    seed: jax.Array, x_bits: jax.Array
+) -> tuple[GarbledEqBatch, GarblerSecrets]:
+    """Garble B equality tests over S-bit strings in one batched program.
+
+    seed:   uint32[4] fresh randomness seed (labels + offset + masks);
+    x_bits: bool[B, S] the garbler's share-bit strings.
+
+    The result's XOR shares are (secrets.mask, evaluator's decoded bit):
+    ``mask ^ decoded == [x == y]`` — the contract of the reference's
+    ``multiple_gb/ev_equality_test`` pair (equalitytest.rs:25-106).
+    """
+    x_bits = jnp.asarray(x_bits, bool)
+    B, S = x_bits.shape
+    # label material: R + X0[B,S] + Y0[B,S] labels + B mask bits
+    n_words = 4 + 2 * B * S * 4 + ((B + 31) // 32)
+    words = prg.stream_words(jnp.asarray(seed, jnp.uint32), n_words)
+    R = words[:4].at[0].set(words[0] | 1)  # lsb(R) = 1 (point-and-permute)
+    X0 = words[4 : 4 + B * S * 4].reshape(B, S, 4)
+    Y0 = words[4 + B * S * 4 : 4 + 2 * B * S * 4].reshape(B, S, 4)
+    mask_words = words[4 + 2 * B * S * 4 :]
+    mask = (
+        (mask_words[jnp.arange(B) // 32] >> (jnp.arange(B) % 32)) & 1
+    ).astype(bool)
+
+    # XNOR relabel (free): Z0_i = X0_i ^ Y0_i ^ R
+    Z0 = X0 ^ Y0 ^ R
+    out0, tables = _and_tree_garble(Z0, jnp.broadcast_to(R, (B, 4)))
+    decode = _lsb(out0) ^ mask
+    gb_labels = X0 ^ _maskw(x_bits, R)
+    return (
+        GarbledEqBatch(tables=tables, gb_labels=gb_labels, decode=decode),
+        GarblerSecrets(mask=mask, ev_label0=Y0, ev_label1=Y0 ^ R),
+    )
+
+
+@jax.jit
+def eval_equality(batch: GarbledEqBatch, ev_labels: jax.Array) -> jax.Array:
+    """Evaluate a garbled batch with the evaluator's OT-received labels.
+
+    ev_labels: uint32[B, S, 4].  Returns bool[B] — the evaluator's XOR
+    share of each equality result (= eq ^ garbler mask).
+    """
+    z = batch.gb_labels ^ ev_labels  # active labels of the XNOR wires
+    out = _and_tree_eval(z, batch.tables)
+    return _lsb(out) ^ batch.decode

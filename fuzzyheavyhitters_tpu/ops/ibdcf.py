@@ -151,6 +151,65 @@ def _gen_pair_jit(init_seeds, alpha_bits, side, derived_bits):
     return mk(0), mk(1)
 
 
+def gen_pair_np(
+    init_seeds: np.ndarray,
+    alpha_bits: np.ndarray,
+    side: np.ndarray,
+    derived_bits: bool | None = None,
+) -> tuple[IbDcfKeyBatch, IbDcfKeyBatch]:
+    """NumPy mirror of :func:`gen_pair` — bit-identical key batches.
+
+    The level recurrence runs as a Python loop over ``L`` with every key in
+    the batch advancing as vectorized numpy — no device, no compilation.
+    Used by host-side client simulation and by CPU-mesh dryruns/tests, where
+    compiling the keygen scan on XLA:CPU is pathologically slow
+    (tests/conftest.py documents the measurement).
+    """
+    if derived_bits is None:
+        derived_bits = prg.DERIVED_BITS
+    init_seeds = np.asarray(init_seeds, np.uint32)
+    alpha = np.asarray(alpha_bits, bool)
+    batch = alpha.shape[:-1]
+    side = np.broadcast_to(np.asarray(side, bool), batch)
+    L = alpha.shape[-1]
+    assert init_seeds.shape == batch + (2, 4), (init_seeds.shape, batch)
+
+    seeds = init_seeds.copy()  # [..., 2, 4]
+    tbits = np.broadcast_to(np.array([False, True]), batch + (2,)).copy()
+    cw_seed = np.empty(batch + (L, 4), np.uint32)
+    cw_bits = np.empty(batch + (L, 2), bool)
+    cw_y = np.empty(batch + (L, 2), bool)
+    for lvl in range(L):
+        s_l, s_r, d_bits, d_y = prg.np_expand(seeds, derived_bits)
+        keep = alpha[..., lvl]  # bool[...]
+        k1 = keep[..., None]
+        cw_seed[..., lvl, :] = np.where(
+            k1, s_l[..., 0, :] ^ s_l[..., 1, :], s_r[..., 0, :] ^ s_r[..., 1, :]
+        )
+        cw_bits[..., lvl, 0] = d_bits[..., 0, 0] ^ d_bits[..., 1, 0] ^ ~keep
+        cw_bits[..., lvl, 1] = d_bits[..., 0, 1] ^ d_bits[..., 1, 1] ^ keep
+        cw_y[..., lvl, 0] = d_y[..., 0, 0] ^ d_y[..., 1, 0] ^ (keep & ~side)
+        cw_y[..., lvl, 1] = d_y[..., 0, 1] ^ d_y[..., 1, 1] ^ (~keep & side)
+        kept_seed = np.where(keep[..., None, None], s_r, s_l)  # [..., 2, 4]
+        kept_bit = np.where(k1, d_bits[..., 1], d_bits[..., 0])  # [..., 2]
+        seeds = np.where(
+            tbits[..., None], kept_seed ^ cw_seed[..., lvl, None, :], kept_seed
+        )
+        cw_keep_bit = np.where(keep, cw_bits[..., lvl, 1], cw_bits[..., lvl, 0])
+        tbits = kept_bit ^ (tbits & cw_keep_bit[..., None])
+
+    def mk(p: int) -> IbDcfKeyBatch:
+        return IbDcfKeyBatch(
+            key_idx=np.broadcast_to(np.bool_(bool(p)), batch),
+            root_seed=init_seeds[..., p, :],
+            cw_seed=cw_seed,
+            cw_bits=cw_bits,
+            cw_y_bits=cw_y,
+        )
+
+    return mk(0), mk(1)
+
+
 @jax.jit
 def eval_init(key: IbDcfKeyBatch) -> EvalState:
     """Root state: seed = root seed, t = y = key_idx (ref: ibDCF.rs:229-236)."""
@@ -243,8 +302,17 @@ def _rng_seeds(rng: np.random.Generator, shape) -> np.ndarray:
     return rng.integers(0, 1 << 32, size=tuple(shape) + (2, 4), dtype=np.uint32)
 
 
+def _gen(engine: str):
+    """Select the keygen implementation: "jax" (device) or "np" (host)."""
+    if engine == "jax":
+        return gen_pair
+    if engine == "np":
+        return gen_pair_np
+    raise ValueError(f"unknown keygen engine {engine!r}")
+
+
 def gen_interval(
-    left_bits, right_bits, rng: np.random.Generator
+    left_bits, right_bits, rng: np.random.Generator, engine: str = "jax"
 ) -> tuple[tuple[IbDcfKeyBatch, IbDcfKeyBatch], tuple[IbDcfKeyBatch, IbDcfKeyBatch]]:
     """Interval keys: (left-DCF side=True on the left bound, right-DCF
     side=False on the right bound), batched (ref: ibDCF.rs:166-173).
@@ -254,8 +322,9 @@ def gen_interval(
     """
     left_bits = np.asarray(left_bits, bool)
     right_bits = np.asarray(right_bits, bool)
-    l0, l1 = gen_pair(_rng_seeds(rng, left_bits.shape[:-1]), left_bits, True)
-    r0, r1 = gen_pair(_rng_seeds(rng, right_bits.shape[:-1]), right_bits, False)
+    g = _gen(engine)
+    l0, l1 = g(_rng_seeds(rng, left_bits.shape[:-1]), left_bits, True)
+    r0, r1 = g(_rng_seeds(rng, right_bits.shape[:-1]), right_bits, False)
     return (l0, r0), (l1, r1)
 
 
@@ -290,7 +359,7 @@ def ball_bounds(points_bits, ball_size: int) -> tuple[np.ndarray, np.ndarray]:
 
 
 def gen_l_inf_ball(
-    points_bits, ball_size: int, rng: np.random.Generator
+    points_bits, ball_size: int, rng: np.random.Generator, engine: str = "jax"
 ) -> tuple[IbDcfKeyBatch, IbDcfKeyBatch]:
     """L∞-ball keys around MSB-first points (ref: ibDCF.rs:175-188).
 
@@ -305,11 +374,11 @@ def gen_l_inf_ball(
     side = np.broadcast_to(
         np.array([True, False]), alpha.shape[:-1]
     )  # left-DCF then right-DCF
-    return gen_pair(_rng_seeds(rng, alpha.shape[:-1]), alpha, side)
+    return _gen(engine)(_rng_seeds(rng, alpha.shape[:-1]), alpha, side)
 
 
 def gen_l_inf_ball_from_coords(
-    coords: np.ndarray, ball_size: int, rng: np.random.Generator
+    coords: np.ndarray, ball_size: int, rng: np.random.Generator, engine: str = "jax"
 ) -> tuple[IbDcfKeyBatch, IbDcfKeyBatch]:
     """i16 coordinate variant with clamping (ref: ibDCF.rs:189-205).
 
@@ -334,4 +403,4 @@ def gen_l_inf_ball_from_coords(
     ).astype(bool)
     alpha = np.stack([to_bits(lo), to_bits(hi)], axis=-2)  # [N, d, 2, 16]
     side = np.broadcast_to(np.array([True, False]), alpha.shape[:-1])
-    return gen_pair(_rng_seeds(rng, alpha.shape[:-1]), alpha, side)
+    return _gen(engine)(_rng_seeds(rng, alpha.shape[:-1]), alpha, side)

@@ -208,6 +208,31 @@ def np_chacha_block(block: np.ndarray) -> np.ndarray:
         )
 
 
+def np_expand(seed: np.ndarray, derived_bits: bool | None = None):
+    """NumPy twin of :func:`expand` — same shapes and bit-exact outputs.
+
+    Lets host-only paths (client simulators, CPU-mesh dryruns) build key
+    material without compiling the device program: XLA:CPU compiles the
+    keygen scan pathologically slowly (see tests/conftest.py), and the
+    NumPy mirror sidesteps the device entirely.
+    """
+    if derived_bits is None:
+        derived_bits = DERIVED_BITS
+    seed = np.array(seed, np.uint32, copy=True)
+    seed[..., 0] &= np.uint32(0xFFFFFFF0)  # mask_seed (prg.rs:97)
+    out = np_chacha_block(seed)
+    s_l = out[..., 0:4]
+    s_r = out[..., 4:8]
+    if derived_bits:
+        w = out[..., 8]
+        bits = np.stack([w & 1 == 0, w & 2 == 0], axis=-1)
+        y_bits = np.stack([w & 4 == 0, w & 8 == 0], axis=-1)
+    else:
+        bits = np.ones(seed.shape[:-1] + (2,), bool)
+        y_bits = np.ones(seed.shape[:-1] + (2,), bool)
+    return s_l, s_r, bits, y_bits
+
+
 def np_expand_bytes(seed: bytes, derived_bits: bool | None = None):
     """bytes-interface twin of :func:`expand` for the spec oracle.
 
@@ -227,6 +252,20 @@ def np_expand_bytes(seed: bytes, derived_bits: bool | None = None):
         bits = (True, True)
         y_bits = (True, True)
     return s_l, s_r, bits, y_bits
+
+
+def np_stream_words(seed: np.ndarray, n_words: int) -> np.ndarray:
+    """NumPy twin of :func:`stream_words` (seed unmasked, ctr in word 0)."""
+    seed = np.asarray(seed, np.uint32)
+    n_blocks = -(-n_words // 16)
+    ctr = np.arange(n_blocks, dtype=np.uint32)
+    blocks = np.broadcast_to(
+        seed[..., None, :], seed.shape[:-1] + (n_blocks, 4)
+    ).copy()
+    with np.errstate(over="ignore"):
+        blocks[..., 0] += ctr
+    out = np_chacha_block(blocks)
+    return out.reshape(out.shape[:-2] + (n_blocks * 16,))[..., :n_words]
 
 
 def seeds_from_bytes(data: bytes) -> np.ndarray:
