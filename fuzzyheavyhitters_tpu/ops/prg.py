@@ -95,7 +95,16 @@ def chacha_block(block: jax.Array) -> jax.Array:
         x[1], x[6], x[11], x[12] = _quarter_round(x[1], x[6], x[11], x[12])
         x[2], x[7], x[8], x[13] = _quarter_round(x[2], x[7], x[8], x[13])
         x[3], x[4], x[9], x[14] = _quarter_round(x[3], x[4], x[9], x[14])
-    return jnp.stack([a + b for a, b in zip(x, init)], axis=-1)
+    out = jnp.stack([a + b for a, b in zip(x, init)], axis=-1)
+    # Fusion fence: without it, XLA:CPU's loop-fusion emitter re-evaluates
+    # the entire ~400-op ChaCha DAG once per consumer output element when a
+    # consumer slices this block (e.g. out[..., 0:4]), which turns kernels
+    # that output seed tensors (keygen scan, advance) into hour-scale
+    # compiles.  Measured: a shard_mapped expand->slice at [128,32,2,2] hung
+    # >300 s without the barrier, 2.5 s with it.  The cost elsewhere is ~nil:
+    # the block is materialized at kernel boundaries anyway, and TPU bench
+    # throughput is re-checked in bench.py.
+    return jax.lax.optimization_barrier(out)
 
 
 def mask_seed(seed: jax.Array) -> jax.Array:

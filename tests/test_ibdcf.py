@@ -17,6 +17,7 @@ import oracle
 import pytest
 
 from fuzzyheavyhitters_tpu.ops import ibdcf, prg
+from fuzzyheavyhitters_tpu.ops.ibdcf import IbDcfKeyBatch
 from fuzzyheavyhitters_tpu.utils import bits as bitutils
 
 
@@ -49,6 +50,25 @@ def test_keygen_matches_oracle_bit_exact(rng):
             np.testing.assert_array_equal(np.asarray(jk.cw_seed), ek.cw_seed)
             np.testing.assert_array_equal(np.asarray(jk.cw_bits), ek.cw_bits)
             np.testing.assert_array_equal(np.asarray(jk.cw_y_bits), ek.cw_y_bits)
+
+
+def test_gen_pair_np_matches_gen_pair(rng):
+    """The host-side keygen mirror must stay bit-identical to the device
+    scan — mesh tests and client simulators depend on interchangeability."""
+    n, d, L = 5, 2, 9
+    alpha = rng.integers(0, 2, size=(n, d, L)).astype(bool)
+    seeds = rng.integers(0, 2**32, size=(n, d, 2, 4), dtype=np.uint32)
+    side = rng.integers(0, 2, size=(n, d)).astype(bool)
+    for derived in (False, True):
+        jk = ibdcf._gen_pair_jit(seeds, alpha, side, derived)
+        nk = ibdcf.gen_pair_np(seeds, alpha, side, derived)
+        for p in range(2):
+            for name in IbDcfKeyBatch._fields:
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(jk[p], name)),
+                    np.asarray(getattr(nk[p], name)),
+                    err_msg=f"party {p} field {name} derived={derived}",
+                )
 
 
 class _FixedSeeds:
