@@ -97,8 +97,12 @@ def _self_check() -> None:
 _self_check()
 
 
-def _seed_from_point(p: Point) -> np.ndarray:
-    digest = hashlib.sha256(_compress(p)).digest()[:16]
+def _seed_from_point(p: Point, idx: int) -> np.ndarray:
+    """H(index, point) -> 128-bit seed.  The OT index is part of the hash
+    input (standard Chou-Orlandi domain separation) so identical points at
+    different indices / instances cannot yield identical seeds."""
+    data = b"fhh-baseot-v1" + idx.to_bytes(4, "little") + _compress(p)
+    digest = hashlib.sha256(data).digest()[:16]
     return np.frombuffer(digest, dtype="<u4").copy()
 
 
@@ -123,17 +127,21 @@ class BaseOtSender:
         """[R_i] -> (seeds0 uint32[n, 4], seeds1 uint32[n, 4])."""
         neg_aA = _neg(_mul(self._a, self._A))
         k0, k1 = [], []
-        for r in r_points:
+        for i, r in enumerate(r_points):
             ar = _mul(self._a, r)
-            k0.append(_seed_from_point(ar))
-            k1.append(_seed_from_point(_add(ar, neg_aA)))
+            k0.append(_seed_from_point(ar, i))
+            k1.append(_seed_from_point(_add(ar, neg_aA), i))
         return np.stack(k0), np.stack(k1)
 
 
-def _decompress(data: bytes) -> Point:
+def decompress(data: bytes) -> Point:
+    """Decode a compressed point; raises ValueError on malformed peer input
+    (never ``assert`` — a protocol-boundary check must survive ``-O``)."""
     raw = int.from_bytes(data, "little")
     y = raw & ((1 << 255) - 1)
     sign = raw >> 255
+    if y >= P:
+        raise ValueError("invalid point encoding: y out of range")
     # x^2 = (y^2 - 1) / (d y^2 + 1)
     num = (y * y - 1) % P
     den = (D * y * y + 1) % P
@@ -141,10 +149,16 @@ def _decompress(data: bytes) -> Point:
     x = pow(x2, (P + 3) // 8, P)
     if (x * x - x2) % P != 0:
         x = x * pow(2, (P - 1) // 4, P) % P
-    assert (x * x - x2) % P == 0, "not a square: invalid point"
+    if (x * x - x2) % P != 0:
+        raise ValueError("invalid point encoding: not a square")
+    if x == 0 and sign:
+        raise ValueError("invalid point encoding: sign bit on x = 0")
     if x & 1 != sign:
         x = P - x
     return Point(x, y, 1, (x * y) % P)
+
+
+_decompress = decompress  # back-compat alias
 
 
 class BaseOtReceiver:
@@ -168,7 +182,9 @@ class BaseOtReceiver:
 
     def seeds(self) -> np.ndarray:
         """uint32[n, 4] — seed k(c_i) for each choice."""
-        return np.stack([_seed_from_point(_mul(b, self._A)) for b in self._bs])
+        return np.stack(
+            [_seed_from_point(_mul(b, self._A), i) for i, b in enumerate(self._bs)]
+        )
 
 
 def exchange(

@@ -28,8 +28,17 @@ of fused tensor ops:
   bit — identical XOR-share semantics, zero extra gates.
 
 The evaluator receives the garbler's input labels directly and its own via
-OT (ops/otext.py), exactly the reference's wire-exchange split
-(equalitytest.rs:68-82, 109-125).
+OT, exactly the reference's wire-exchange split (equalitytest.rs:68-82,
+109-125).  Two delivery modes:
+
+- ``garble_equality`` draws everything (R, X0, Y0, masks) from a seed; the
+  evaluator label pairs come back in ``GarblerSecrets`` for an explicit
+  payload OT — the self-contained form (tests, small batches).
+- ``garble_equality_delta`` takes ``R`` and the evaluator zero-labels
+  ``Y0`` externally, for the Δ-OT fusion with IKNP extension
+  (ops/otext.py): the garbler sets ``R = s`` and ``Y0_j = Q_j``, so the
+  receiver's ``T_j = Q_j ^ y_j·s`` *is* its active input label — labels
+  arrive with zero messages beyond the extension's u-matrix.
 """
 
 from __future__ import annotations
@@ -147,6 +156,36 @@ def _and_tree_eval(wires, tables):
     return wires[..., 0, :]
 
 
+def _carve_label_words(seed, B: int, S: int, n_label_sets: int, with_r: bool):
+    """Draw [optional R] + ``n_label_sets`` [B, S, 4] label blocks + B mask
+    bits from the PRG stream — the shared randomness layout of both garble
+    entry points."""
+    r_words = 4 if with_r else 0
+    n_words = r_words + n_label_sets * B * S * 4 + ((B + 31) // 32)
+    words = prg.stream_words(jnp.asarray(seed, jnp.uint32), n_words)
+    R = words[:4].at[0].set(words[0] | 1) if with_r else None  # lsb(R) = 1
+    base = r_words
+    sets = [
+        words[base + k * B * S * 4 : base + (k + 1) * B * S * 4].reshape(B, S, 4)
+        for k in range(n_label_sets)
+    ]
+    mask_words = words[base + n_label_sets * B * S * 4 :]
+    mask = (
+        (mask_words[jnp.arange(B) // 32] >> (jnp.arange(B) % 32)) & 1
+    ).astype(bool)
+    return R, sets, mask
+
+
+def _garble_core(R, X0, Y0, mask, x_bits):
+    """Shared garbling core: labels + offset in, garbled batch out."""
+    B = x_bits.shape[0]
+    Z0 = X0 ^ Y0 ^ R  # XNOR relabel (free): Z0_i = X0_i ^ Y0_i ^ R
+    out0, tables = _and_tree_garble(Z0, jnp.broadcast_to(R, (B, 4)))
+    decode = _lsb(out0) ^ mask
+    gb_labels = X0 ^ _maskw(x_bits, R)
+    return GarbledEqBatch(tables=tables, gb_labels=gb_labels, decode=decode)
+
+
 @jax.jit
 def garble_equality(
     seed: jax.Array, x_bits: jax.Array
@@ -163,25 +202,31 @@ def garble_equality(
     x_bits = jnp.asarray(x_bits, bool)
     B, S = x_bits.shape
     # label material: R + X0[B,S] + Y0[B,S] labels + B mask bits
-    n_words = 4 + 2 * B * S * 4 + ((B + 31) // 32)
-    words = prg.stream_words(jnp.asarray(seed, jnp.uint32), n_words)
-    R = words[:4].at[0].set(words[0] | 1)  # lsb(R) = 1 (point-and-permute)
-    X0 = words[4 : 4 + B * S * 4].reshape(B, S, 4)
-    Y0 = words[4 + B * S * 4 : 4 + 2 * B * S * 4].reshape(B, S, 4)
-    mask_words = words[4 + 2 * B * S * 4 :]
-    mask = (
-        (mask_words[jnp.arange(B) // 32] >> (jnp.arange(B) % 32)) & 1
-    ).astype(bool)
+    R, (X0, Y0), mask = _carve_label_words(seed, B, S, 2, with_r=True)
+    batch = _garble_core(R, X0, Y0, mask, x_bits)
+    return batch, GarblerSecrets(mask=mask, ev_label0=Y0, ev_label1=Y0 ^ R)
 
-    # XNOR relabel (free): Z0_i = X0_i ^ Y0_i ^ R
-    Z0 = X0 ^ Y0 ^ R
-    out0, tables = _and_tree_garble(Z0, jnp.broadcast_to(R, (B, 4)))
-    decode = _lsb(out0) ^ mask
-    gb_labels = X0 ^ _maskw(x_bits, R)
-    return (
-        GarbledEqBatch(tables=tables, gb_labels=gb_labels, decode=decode),
-        GarblerSecrets(mask=mask, ev_label0=Y0, ev_label1=Y0 ^ R),
-    )
+
+@jax.jit
+def garble_equality_delta(
+    R: jax.Array, Y0: jax.Array, seed: jax.Array, x_bits: jax.Array
+) -> tuple[GarbledEqBatch, jax.Array]:
+    """Garble with Δ-OT-supplied evaluator labels (see module docstring).
+
+    R:      uint32[4] global offset = the OT-extension sender's ``s``
+            (lsb must be 1 — otext.fresh_s_bits guarantees it);
+    Y0:     uint32[B, S, 4] evaluator zero-labels = the extension's Q rows;
+    seed:   uint32[4] randomness for the garbler's own labels + masks;
+    x_bits: bool[B, S].
+
+    Returns (batch, mask): ``mask`` is the garbler's XOR output share.
+    """
+    x_bits = jnp.asarray(x_bits, bool)
+    B, S = x_bits.shape
+    _, (X0,), mask = _carve_label_words(seed, B, S, 1, with_r=False)
+    R = jnp.asarray(R, jnp.uint32)
+    batch = _garble_core(R, X0, jnp.asarray(Y0, jnp.uint32), mask, x_bits)
+    return batch, mask
 
 
 @jax.jit

@@ -1,0 +1,227 @@
+"""IKNP OT extension as batched device tensor ops.
+
+The reference consumes OT extension through ocelot's ``AlszSender`` /
+``AlszReceiver`` (ref: src/collect.rs:10-11, 454-461) — per-thread Rust
+state machines over TCP channels.  The TPU-native redesign observes that the
+whole IKNP03 extension is three tensor primitives — column PRG expansion,
+bit-matrix transpose, and XOR — plus one correlation-robust hash, all of
+which batch perfectly on device:
+
+- 128 **base OTs** (ops/baseot.py, Chou-Orlandi on the host) seed the
+  extension; the extension *sender* played base-OT *receiver* with its
+  secret choice vector ``s`` and vice versa (the standard IKNP role flip).
+- To extend to ``m`` OTs: the receiver, with choice bits ``r``, derives
+  column streams ``t_i = G(k0_i)`` and sends ``u_i = t_i ^ G(k1_i) ^ r``;
+  the sender derives ``q_i = G(k_{s_i}) ^ s_i·u_i``.  Row-wise,
+  ``Q_j = T_j ^ r_j·s`` — a 1-of-2 correlated OT on 128-bit rows.
+- **Δ-OT view** (no hash): ``T_j`` IS the receiver's choice-selected label
+  when the sender uses ``Q_j`` as its zero-label with global offset ``s``.
+  The GC layer exploits this by setting its free-XOR offset ``R = s`` —
+  evaluator input labels then arrive with zero extra messages (ops/gc.py).
+- **Chosen-payload view**: pads ``H(j, Q_j)`` / ``H(j, Q_j ^ s)`` encrypt
+  arbitrary per-OT payloads (the b2a field blocks of collect.rs:439-471);
+  the receiver recovers its choice with ``H(j, T_j)``.  H is the fixed-key
+  ChaCha hash (ops/prg.py) with an OT-specific tweak.
+
+Semi-honest security, matching the reference's use (its Alsz instantiation
+is the malicious-OT variant of IKNP, but the surrounding protocol is
+semi-honest; ref: equalitytest.rs uses twopac semi-honest garbling).
+
+Both parties must call ``extend`` the same number of times with the same
+``m`` — the PRG stream counters advance in lockstep (like the shared
+channel position in the reference's ocelot session).
+"""
+
+from __future__ import annotations
+
+import secrets
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import baseot, prg
+
+KAPPA = 128  # security parameter: base-OT count == row width in bits
+
+# OT-hash tweak constants (words 1..3); word 0 carries the OT index.
+# Distinct from the GC gate-hash tweak (ops/gc.py) by construction.
+_OT_TWEAK1 = 0x4F545F31
+_OT_TWEAK2 = 0xB7E15162
+_OT_TWEAK3 = 0x8AED2A6B
+
+
+def pack_bits(bits: jax.Array) -> jax.Array:
+    """bool[..., m] -> uint32[..., ceil(m/32)] little-endian bit packing."""
+    bits = jnp.asarray(bits, bool)
+    m = bits.shape[-1]
+    w = -(-m // 32)
+    pad = jnp.zeros(bits.shape[:-1] + (w * 32 - m,), bool)
+    b = jnp.concatenate([bits, pad], axis=-1).reshape(bits.shape[:-1] + (w, 32))
+    return jnp.sum(
+        b.astype(jnp.uint32) << jnp.arange(32, dtype=jnp.uint32), axis=-1,
+        dtype=jnp.uint32,
+    )
+
+
+def unpack_bits(words: jax.Array, m: int) -> jax.Array:
+    """uint32[..., w] -> bool[..., m] (inverse of :func:`pack_bits`)."""
+    words = jnp.asarray(words, jnp.uint32)
+    idx = jnp.arange(m)
+    return ((words[..., idx // 32] >> (idx % 32).astype(jnp.uint32)) & 1).astype(bool)
+
+
+@partial(jax.jit, static_argnames=("m",))
+def _transpose_pack(cols: jax.Array, m: int) -> jax.Array:
+    """Column-major bit matrix -> packed 128-bit rows.
+
+    cols: uint32[128, W] where bit j of cols[i] is entry (row j, column i).
+    Returns uint32[m, 4]: row j's 128 column bits packed into 4 words.
+    """
+    bits = unpack_bits(cols, m)  # [128, m]
+    rows = bits.T  # [m, 128]
+    return pack_bits(rows)  # [m, 4]
+
+
+@partial(jax.jit, static_argnames=("w",))
+def _col_words(seeds: jax.Array, w: int, offset) -> jax.Array:
+    """Per-column PRG streams: uint32[128, 4] seeds -> uint32[128, w]."""
+    nb = -(-w // 16)
+    blocks = prg.stream_blocks(seeds, nb, offset)  # [128, nb, 16]
+    return blocks.reshape(128, nb * 16)[:, :w]
+
+
+@partial(jax.jit, static_argnames=("m",))
+def _receiver_extend(seeds0, seeds1, choices, offset, m):
+    w = -(-m // 32)
+    t = _col_words(seeds0, w, offset)
+    g1 = _col_words(seeds1, w, offset)
+    r_words = pack_bits(jnp.asarray(choices, bool))  # [w]
+    u = t ^ g1 ^ r_words[None, :]
+    return u, _transpose_pack(t, m)
+
+
+@partial(jax.jit, static_argnames=("m",))
+def _sender_extend(seeds, s_bits, u, offset, m):
+    w = -(-m // 32)
+    g = _col_words(seeds, w, offset)
+    q = g ^ jnp.where(jnp.asarray(s_bits, bool)[:, None], u, jnp.uint32(0))
+    return _transpose_pack(q, m)
+
+
+@partial(jax.jit, static_argnames=("n_words",))
+def ot_hash(rows: jax.Array, n_words: int, idx_offset=0) -> jax.Array:
+    """Correlation-robust hash of 128-bit rows -> uint32[..., n_words] pads.
+
+    The per-row OT index is folded into the tweak so identical rows at
+    different positions hash independently (the `H(j, ·)` of IKNP).
+    """
+    rows = jnp.asarray(rows, jnp.uint32)
+    m = rows.shape[-2]
+    idx = jnp.arange(m, dtype=jnp.uint32) + jnp.asarray(idx_offset, jnp.uint32)
+    shape = rows.shape[:-1]
+    tweak = jnp.stack(
+        [
+            jnp.broadcast_to(idx, shape),
+            jnp.full(shape, _OT_TWEAK1, jnp.uint32),
+            jnp.full(shape, _OT_TWEAK2, jnp.uint32),
+            jnp.full(shape, _OT_TWEAK3, jnp.uint32),
+        ],
+        axis=-1,
+    )
+    return prg.chacha_block(rows ^ tweak)[..., :n_words]
+
+
+def s_to_block(s_bits: np.ndarray) -> np.ndarray:
+    """bool[128] -> uint32[4] — the sender's ``s`` as a label-sized block."""
+    return np.asarray(pack_bits(np.asarray(s_bits, bool)))
+
+
+class OtExtSender:
+    """Extension sender: holds ``s`` and the base seeds chosen by ``s``.
+
+    ``s_bits[0]`` is forced to 1 so ``s`` doubles as a free-XOR offset R
+    with lsb(R)=1 (point-and-permute; ops/gc.py garbles with R = s).
+    """
+
+    def __init__(self, s_bits: np.ndarray, seeds: np.ndarray):
+        s_bits = np.asarray(s_bits, bool)
+        if s_bits.shape != (KAPPA,) or not s_bits[0]:
+            raise ValueError("need 128 choice bits with lsb(s) = 1")
+        if seeds.shape != (KAPPA, 4):
+            raise ValueError(f"need uint32[128, 4] base seeds, got {seeds.shape}")
+        self.s_bits = s_bits
+        self.s_block = s_to_block(s_bits)  # uint32[4]
+        self._seeds = jnp.asarray(seeds, jnp.uint32)
+        self._s_dev = jnp.asarray(s_bits)
+        self._off = 0
+        self._sent = 0
+
+    @property
+    def consumed(self) -> int:
+        """Total OTs extended so far — the pad-tweak index base for the next
+        batch (both endpoints' ``consumed`` advance in lockstep)."""
+        return self._sent
+
+    def extend(self, m: int, u_msg) -> jax.Array:
+        """Peer's u-matrix -> Q rows uint32[m, 4] (Q_j = T_j ^ r_j·s)."""
+        q = _sender_extend(self._seeds, self._s_dev, jnp.asarray(u_msg), self._off, m)
+        w = -(-m // 32)
+        self._off += -(-w // 16)  # blocks consumed from each column stream
+        self._sent += m
+        return q
+
+    def pads(self, q_rows: jax.Array, n_words: int, idx_offset: int):
+        """(pad0, pad1) uint32[m, n_words] for chosen-payload OT."""
+        p0 = ot_hash(q_rows, n_words, idx_offset)
+        p1 = ot_hash(q_rows ^ jnp.asarray(self.s_block), n_words, idx_offset)
+        return p0, p1
+
+
+class OtExtReceiver:
+    """Extension receiver: holds both base-seed columns (it played base-OT
+    sender), produces the u message and its T rows per batch."""
+
+    def __init__(self, seeds0: np.ndarray, seeds1: np.ndarray):
+        if seeds0.shape != (KAPPA, 4) or seeds1.shape != (KAPPA, 4):
+            raise ValueError("need two uint32[128, 4] base-seed columns")
+        self._seeds0 = jnp.asarray(seeds0, jnp.uint32)
+        self._seeds1 = jnp.asarray(seeds1, jnp.uint32)
+        self._off = 0
+        self._recv = 0
+
+    @property
+    def consumed(self) -> int:
+        """Total OTs extended so far (see OtExtSender.consumed)."""
+        return self._recv
+
+    def extend(self, choices) -> tuple[jax.Array, jax.Array]:
+        """choices bool[m] -> (u message uint32[128, ceil(m/32)],
+        T rows uint32[m, 4]).  T_j is the Δ-OT label for choice r_j."""
+        choices = jnp.asarray(choices, bool)
+        m = choices.shape[0]
+        u, t = _receiver_extend(self._seeds0, self._seeds1, choices, self._off, m)
+        w = -(-m // 32)
+        self._off += -(-w // 16)
+        self._recv += m
+        return u, t
+
+    def pads(self, t_rows: jax.Array, n_words: int, idx_offset: int) -> jax.Array:
+        """uint32[m, n_words] — the receiver's chosen pad H(j, T_j)."""
+        return ot_hash(t_rows, n_words, idx_offset)
+
+
+def fresh_s_bits(rng: secrets.SystemRandom | None = None) -> np.ndarray:
+    """Random sender choice vector with lsb forced to 1 (free-XOR ready)."""
+    rand = rng or secrets.SystemRandom()
+    bits = np.array([bool(rand.getrandbits(1)) for _ in range(KAPPA)])
+    bits[0] = True
+    return bits
+
+
+def inprocess_pair() -> tuple[OtExtSender, OtExtReceiver]:
+    """Run the base-OT setup in-process (tests / colocated mesh parties)."""
+    s_bits = fresh_s_bits()
+    seeds0, seeds1, chosen = baseot.exchange(s_bits)
+    return OtExtSender(s_bits, chosen), OtExtReceiver(seeds0, seeds1)

@@ -145,7 +145,7 @@ def _expand_jit(seed: jax.Array, derived_bits: bool):
 
 
 @partial(jax.jit, static_argnames=("n_blocks",))
-def stream_blocks(seed: jax.Array, n_blocks: int) -> jax.Array:
+def stream_blocks(seed: jax.Array, n_blocks: int, offset=0) -> jax.Array:
     """CTR-mode stream: uint32[..., 4] seed -> uint32[..., n_blocks, 16].
 
     The seed is the starting counter block; successive blocks increment word 0
@@ -154,9 +154,12 @@ def stream_blocks(seed: jax.Array, n_blocks: int) -> jax.Array:
     initial counter (prg.rs:199-232).  Unlike :func:`expand`, the stream path
     uses the seed **unmasked** — the reference masks only in ``expand_dir``
     (prg.rs:97), not in its CTR stream (prg.rs:136).
+
+    ``offset`` (scalar, may be traced) starts the counter ``offset`` blocks
+    in — session streams (OT extension) consume the stream incrementally.
     """
     seed = jnp.asarray(seed, jnp.uint32)
-    ctr = jnp.arange(n_blocks, dtype=jnp.uint32)
+    ctr = jnp.arange(n_blocks, dtype=jnp.uint32) + jnp.asarray(offset, jnp.uint32)
     blocks = jnp.broadcast_to(
         seed[..., None, :], seed.shape[:-1] + (n_blocks, 4)
     )

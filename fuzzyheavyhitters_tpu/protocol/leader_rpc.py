@@ -79,7 +79,14 @@ class RpcLeader:
                     counts=np.zeros(0, np.uint32),
                 )
             if last:
-                await self._both("tree_prune_last", {"n_alive": n_alive})
+                await self._both(
+                    "tree_prune_last",
+                    {
+                        "parent_idx": parent,
+                        "pattern_bits": pat_bits,
+                        "n_alive": n_alive,
+                    },
+                )
             else:
                 await self._both(
                     "tree_prune",
@@ -97,5 +104,12 @@ class RpcLeader:
             self.paths = new_paths
             self.n_nodes = n_alive
             counts_kept = counts[parent[:n_alive], pattern[:n_alive]]
-        await self._both("final_shares")
-        return CrawlResult(paths=self.paths, counts=counts_kept)
+        # final reconstruction from re-served leaf shares: v0 - v1 per
+        # surviving leaf (ref: collect.rs:993-1029 final_shares/final_values;
+        # the crawl-time counts are only the pruning signal)
+        f0, f1 = await self._both("final_shares")
+        v = np.asarray(F255.sub(f0["shares"], f1["shares"]))
+        final_counts = v[..., 0].astype(np.uint32)
+        if np.any(v[..., 1:]) or not np.array_equal(final_counts, counts_kept):
+            raise RuntimeError("final share reconstruction mismatch")
+        return CrawlResult(paths=self.paths, counts=final_counts)

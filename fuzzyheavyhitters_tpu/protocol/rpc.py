@@ -35,16 +35,18 @@ from __future__ import annotations
 
 import asyncio
 import pickle
+import secrets as _secrets
 import struct
 from dataclasses import dataclass, field
 
+import jax.numpy as jnp
 import numpy as np
 
-from ..ops import ibdcf, prg
+from ..ops import baseot, gc, ibdcf, otext, prg
 from ..ops.fields import F255, FE62
 from ..ops.ibdcf import IbDcfKeyBatch
 from ..utils.config import Config
-from . import collect
+from . import collect, secure
 
 _HDR = struct.Struct("<Q")
 SHARED_MASK_SEED = b"XXX This is bog\x00"  # 16 B, ref: server.rs:331-332
@@ -98,6 +100,10 @@ class CollectorServer:
     frontier: collect.Frontier | None = None
     _peer_reader: asyncio.StreamReader | None = None
     _peer_writer: asyncio.StreamWriter | None = None
+    _ot: object | None = None  # OT-extension endpoint (secure_exchange)
+    _sec_seed: np.ndarray | None = None  # session seed for GC/b2a randomness
+    _crawl_ctr: int = 0  # makes per-crawl garbling randomness unique
+    _last_shares: np.ndarray | None = None  # last-level leaf count shares
 
     # -- verbs (ref: rpc.rs:56-66) ---------------------------------------
 
@@ -106,6 +112,11 @@ class CollectorServer:
         self.keys = None
         self.alive_keys = None
         self.frontier = None
+        self._last_shares = None
+        if self._ot is not None:  # fresh GC/b2a randomness per collection
+            self._sec_seed = np.frombuffer(
+                _secrets.token_bytes(16), dtype="<u4"
+            ).copy()
         return True
 
     async def add_keys(self, req) -> bool:
@@ -139,25 +150,80 @@ class CollectorServer:
         )
         return np.asarray(counts)
 
+    async def _crawl_counts_secure(self, level: int, count_field) -> np.ndarray:
+        """The real 2PC data plane (ref: collect.rs:419-501): GC equality +
+        OT b2a over the peer socket; returns this server's additive field
+        share of every per-(node, pattern) count.  No packed share-bit
+        tensor ever crosses the server boundary in this mode."""
+        packed = collect.expand_share_bits(self.keys, self.frontier, level)
+        d = self.keys.cw_seed.shape[1]
+        C, S = 1 << d, 2 * d
+        strs = secure.child_strings(packed, d)  # [F, C, N, S]
+        F_, _, N, _ = strs.shape
+        B = F_ * C * N
+        flat = strs.reshape(B, S)
+        w = secure.alive_weight(self.frontier.alive, self.alive_keys, C)
+        # crawl counter makes every garbling's randomness unique even if a
+        # leader re-crawls a level without reset (seed reuse with a fixed
+        # R = s would leak cross-run equality deltas to the evaluator)
+        self._crawl_ctr += 1
+        gc_seed = secure.derive_seed(self._sec_seed, 1, level, self._crawl_ctr)
+        b2a_seed = secure.derive_seed(self._sec_seed, 2, level, self._crawl_ctr)
+        if self.server_id == 0:  # garbler + OT sender (gc_sender=true role)
+            u = await _recv(self._peer_reader)
+            batch, mask = secure.gb_step1(self._ot, u, flat, gc_seed)
+            await _send(self._peer_writer, tuple(np.asarray(x) for x in batch))
+            u2 = await _recv(self._peer_reader)
+            c0, c1, vals = secure.gb_step2(self._ot, u2, mask, b2a_seed, count_field)
+            await _send(self._peer_writer, (np.asarray(c0), np.asarray(c1)))
+        else:  # evaluator + OT receiver
+            u, t_rows = secure.ev_step1(self._ot, np.asarray(flat))
+            await _send(self._peer_writer, np.asarray(u))
+            bmsg = await _recv(self._peer_reader)
+            batch = gc.GarbledEqBatch(*(jnp.asarray(x) for x in bmsg))
+            e = secure.ev_step2(batch, t_rows, B, S)
+            u2, t2_rows, idx0 = secure.ev_step3(self._ot, np.asarray(e))
+            await _send(self._peer_writer, np.asarray(u2))
+            c0, c1 = await _recv(self._peer_reader)
+            vals = secure.ev_step4(self._ot, t2_rows, idx0, c0, c1, e, count_field)
+        vals = vals.reshape((F_, C, N) + count_field.limb_shape)
+        shares = secure.node_share_sums(count_field, vals, jnp.asarray(w))
+        return np.asarray(shares)
+
     async def tree_crawl(self, req) -> np.ndarray:
         """-> FE62 shares of per-child counts [F, 2^d] (ref: rpc.rs:60)."""
         level = req["level"]
+        if self.cfg.secure_exchange:
+            return await self._crawl_counts_secure(level, FE62)
         counts = await self._crawl_counts(level)
+        # NB: trusted mode — both servers hold these plaintext counts; the
+        # shared-seed mask below is a WIRE-FORMAT shim so the leader's
+        # uniform v0 - v1 reconstruction works, not a secrecy mechanism
+        # (the reference's hardcoded bogus PRG seed plays the same role,
+        # server.rs:331-332).  Secrecy comes from secure_exchange above.
         r = mask_fe62(level, counts.size).reshape(counts.shape)
         if self.server_id == 0:
             return np.asarray(FE62.add(counts.astype(np.uint64), r))
         return r
 
     async def tree_crawl_last(self, req) -> np.ndarray:
-        """-> F255 shares [F, 2^d, 8] for the final level (ref: rpc.rs:61)."""
+        """-> F255 shares [F, 2^d, 8] for the final level (ref: rpc.rs:61,
+        collect.rs:775-916 — BlockPair double-block OT payloads in secure
+        mode).  Shares are retained for final_shares re-serving."""
         level = req["level"]
-        counts = await self._crawl_counts(level)
-        r = mask_f255(level, counts.size).reshape(counts.shape + (8,))
-        if self.server_id == 0:
-            c = np.zeros(counts.shape + (8,), np.uint32)
-            c[..., 0] = counts
-            return np.asarray(F255.add(c, r))
-        return r
+        if self.cfg.secure_exchange:
+            shares = await self._crawl_counts_secure(level, F255)
+        else:
+            counts = await self._crawl_counts(level)
+            r = mask_f255(level, counts.size).reshape(counts.shape + (8,))
+            if self.server_id == 0:
+                c = np.zeros(counts.shape + (8,), np.uint32)
+                c[..., 0] = counts
+                shares = np.asarray(F255.add(c, r))
+            else:
+                shares = r
+        self._last_shares = shares
+        return shares
 
     async def tree_prune(self, req) -> bool:
         """Fused prune+advance: materialize surviving children
@@ -173,14 +239,23 @@ class CollectorServer:
         return True
 
     async def tree_prune_last(self, req) -> bool:
-        """Last level keeps no child states — only the survivor bookkeeping
-        (ref: collect.rs:931-942); nothing to advance."""
+        """Last level keeps no child states to advance — compact the stored
+        leaf count shares down to the survivors (ref: collect.rs:931-942)."""
+        if self._last_shares is None:  # protocol-boundary check: no assert
+            raise RuntimeError("tree_prune_last called before tree_crawl_last")
+        parent = np.asarray(req["parent_idx"], np.int64)
+        pattern = np.asarray(req["pattern_bits"], bool)
+        n_alive = int(req["n_alive"])
+        d = pattern.shape[1]
+        child = (pattern[:n_alive] << np.arange(d)).sum(axis=1)
+        self._last_shares = self._last_shares[parent[:n_alive], child]
         return True
 
     async def final_shares(self, req) -> dict:
-        """Re-serve the surviving leaves' count shares (ref: rpc.rs:65,
-        collect.rs:993-1004; paths live with the leader here)."""
-        return {"server_id": self.server_id}
+        """Re-serve the surviving leaves' count shares for leader-side
+        reconstruction (ref: rpc.rs:65, collect.rs:993-1004; tree paths
+        live with the leader in this design, see protocol/collect.py)."""
+        return {"server_id": self.server_id, "shares": self._last_shares}
 
     # -- wiring ----------------------------------------------------------
 
@@ -209,7 +284,8 @@ class CollectorServer:
 
     async def start(self, host: str, port: int, peer_host: str, peer_port: int):
         """Bring up the data plane FIRST (like the reference: GC mesh before
-        the RPC listener, server.rs:344-354), then serve the leader."""
+        the RPC listener, server.rs:344-354), run the base-OT handshake if
+        the exchange is secure, then serve the leader."""
         if self.server_id == 1:
             srv = await asyncio.start_server(self._on_peer, host, peer_port)
             self._peer_ready = asyncio.Event()
@@ -225,12 +301,38 @@ class CollectorServer:
             else:
                 raise ConnectionError("peer data-plane unreachable")
             self._peer_reader, self._peer_writer = r, w
+            await self._setup_secure()
         self._rpc_srv = await asyncio.start_server(self._handle_leader, host, port)
         return self._rpc_srv
 
     async def _on_peer(self, reader, writer):
         self._peer_reader, self._peer_writer = reader, writer
+        await self._setup_secure()
         self._peer_ready.set()
+
+    async def _setup_secure(self):
+        """One-time base-OT setup seeding the IKNP extension (the ocelot
+        session init of collect.rs:454-461 — ~128 host-side Chou-Orlandi
+        OTs; all per-level OT volume then runs as device kernels).  Server 0
+        (garbler / OT-extension sender) plays base-OT *receiver* with its
+        secret ``s`` — the standard IKNP role flip (ops/otext.py)."""
+        if not self.cfg.secure_exchange:
+            return
+        if self.server_id == 1:
+            bs = baseot.BaseOtSender()
+            await _send(self._peer_writer, bs.round1())
+            r_msgs = await _recv(self._peer_reader)
+            s0, s1 = bs.seeds([baseot.decompress(m) for m in r_msgs])
+            self._ot = otext.OtExtReceiver(s0, s1)
+        else:
+            s_bits = otext.fresh_s_bits()
+            a_msg = await _recv(self._peer_reader)
+            br = baseot.BaseOtReceiver(s_bits)
+            await _send(self._peer_writer, br.round1(a_msg))
+            self._ot = otext.OtExtSender(s_bits, br.seeds())
+        self._sec_seed = np.frombuffer(
+            _secrets.token_bytes(16), dtype="<u4"
+        ).copy()
 
 
 # ---------------------------------------------------------------------------

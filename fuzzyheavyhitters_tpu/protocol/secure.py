@@ -1,0 +1,202 @@
+"""Secure server↔server data plane: batched GC equality + OT b2a conversion.
+
+This is the 2PC core the reference runs inside ``tree_crawl``
+(ref: src/collect.rs:419-482 driving src/equalitytest.rs:25-191 and ocelot
+OT): per (node, client), the two servers hold share-bit strings that agree
+on every compared position iff the client's ball contains the node's box;
+a garbled-circuit equality test XOR-shares that predicate, and a 1-of-2 OT
+converts each XOR share into an additive field share via the ``r1 - r0 = 1``
+trick (collect.rs:439-471), so per-node counts can be summed as field
+shares neither server can open alone.
+
+TPU-native shape: everything is batched device tensors —
+
+- strings for ALL (node, child-pattern, client) triples come from one
+  bit-extraction on the packed share-bit tensor (``child_strings``);
+- one ``garble_equality_delta`` garbles the whole batch; evaluator input
+  labels ride the IKNP Δ-OT (ops/otext.py) with ``R = s``, so label
+  delivery costs one u-matrix message (vs the reference's per-wire OT);
+- the b2a payloads travel under chosen-payload OT pads from the same
+  extension session; FE62 payloads are one 128-bit block, F255 payloads
+  two blocks — the reference's ``Block`` vs ``BlockPair`` split
+  (collect.rs:439-471 vs 775-916);
+- per-node share sums are alive-gated field reductions on device
+  (collect.rs:487-501's ``add_lazy`` loop as one ``field.sum``).
+
+The step functions here are sans-IO: protocol/rpc.py strings them over the
+data-plane socket (message flow: ev u-matrix → gb garbled batch → ev b2a
+u-matrix → gb ciphertexts — two round trips per level), and parallel/mesh.py
+runs the same math with ``ppermute`` transfers on the 2-chip axis.
+
+Wire-share semantics: garbler's per-test share is always ``r1 = r0 + 1``;
+the evaluator receives ``r0`` when the strings are equal, else ``r1`` —
+so ``v0 - v1 = [x == y]`` per test and summed shares reconstruct counts
+exactly like ``keep_values`` (collect.rs:945-964).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import gc, otext, prg
+from ..ops.fields import F255, FE62
+
+# ---------------------------------------------------------------------------
+# String extraction: packed share bits -> per-(node, pattern, client) strings
+# ---------------------------------------------------------------------------
+
+
+def _string_positions(d: int) -> np.ndarray:
+    """uint32[2^d, 2d] — packed-bit positions of child pattern c's compared
+    string, ordered (dim-major, side minor): the tensor twin of the
+    reference's left||right bit-string layout (collect.rs:393-410), reading
+    direction ``(c >> j) & 1`` per dim (child order: lib.rs:125-129)."""
+    out = np.empty((1 << d, 2 * d), np.uint32)
+    for c in range(1 << d):
+        k = 0
+        for j in range(d):
+            r = (c >> j) & 1
+            for s in range(2):
+                out[c, k] = j * 4 + s * 2 + r
+                k += 1
+    return out
+
+
+@partial(jax.jit, static_argnames=("d",))
+def child_strings(packed: jax.Array, d: int) -> jax.Array:
+    """uint32[F, N] packed share bits -> bool[F, 2^d, N, 2d] strings."""
+    pos = jnp.asarray(_string_positions(d))  # [C, S]
+    return ((packed[:, None, :, None] >> pos[None, :, None, :]) & 1).astype(bool)
+
+
+# ---------------------------------------------------------------------------
+# Field payload codecs (OT payload width: FE62 one block, F255 two blocks)
+# ---------------------------------------------------------------------------
+
+
+def payload_words(field) -> int:
+    return 8 if field is F255 else 4
+
+
+def field_to_words(field, v) -> jax.Array:
+    b = field.to_blocks(v)
+    if field is F255:
+        return b.reshape(b.shape[:-2] + (8,))
+    return b
+
+
+def words_to_field(field, w) -> jax.Array:
+    if field is F255:
+        return field.from_blocks(w.reshape(w.shape[:-1] + (2, 4)))
+    return field.from_blocks(w)
+
+
+def derive_seed(base: np.ndarray, purpose: int, level: int, ctr: int = 0) -> np.ndarray:
+    """Per-(purpose, level, crawl-counter) PRG seed from a session seed."""
+    s = np.array(base, np.uint32, copy=True)
+    s[1] ^= np.uint32(ctr)
+    s[2] ^= np.uint32(purpose)
+    s[3] ^= np.uint32(level)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# Protocol steps (sans-IO).  Roles: garbler = server 0 (gc_sender=true,
+# ref: leader.rs:204-205 pins the role per request), evaluator = server 1.
+# ---------------------------------------------------------------------------
+
+
+def ev_step1(rcv: otext.OtExtReceiver, y_flat):
+    """Evaluator: request input labels.  y_flat bool[B, S] -> (u message,
+    T rows uint32[B*S, 4] — the Δ-OT labels-to-be)."""
+    B, S = y_flat.shape
+    u, t = rcv.extend(np.asarray(y_flat).reshape(B * S))
+    return u, t
+
+
+def gb_step1(snd: otext.OtExtSender, u_msg, x_flat, gc_seed):
+    """Garbler: derive evaluator zero-labels from the extension and garble.
+
+    Returns (batch to send, mask bool[B] — the garbler's XOR shares)."""
+    B, S = x_flat.shape
+    q = snd.extend(B * S, u_msg)
+    y0 = q.reshape(B, S, 4)
+    return gc.garble_equality_delta(
+        jnp.asarray(snd.s_block), y0, jnp.asarray(gc_seed), x_flat
+    )
+
+
+def ev_step2(batch: gc.GarbledEqBatch, t_rows, B: int, S: int) -> jax.Array:
+    """Evaluator: labels are the Δ-OT T rows; evaluate -> XOR shares bool[B]."""
+    return gc.eval_equality(batch, jnp.asarray(t_rows).reshape(B, S, 4))
+
+
+def ev_step3(rcv: otext.OtExtReceiver, e_bits):
+    """Evaluator: open the b2a OT with its GC output shares as choices.
+    Returns (u message, T2 rows, idx0 — the pad tweak base)."""
+    idx0 = rcv.consumed
+    u2, t2 = rcv.extend(np.asarray(e_bits))
+    return u2, t2, idx0
+
+
+def gb_step2(snd: otext.OtExtSender, u2_msg, mask, b2a_seed, field):
+    """Garbler: b2a conversion — sample (r0, r1 = r0+1), order by mask
+    (collect.rs:439-456), encrypt under the OT pads.
+
+    Returns (c0, c1 ciphertext words [B, W], v0 field values [B] — the
+    garbler's additive shares, always r1)."""
+    mask = jnp.asarray(mask, bool)
+    B = mask.shape[0]
+    W = payload_words(field)
+    idx0 = snd.consumed
+    q2 = snd.extend(B, u2_msg)
+    pad0, pad1 = snd.pads(q2, W, idx0)
+    r_words = prg.stream_words(jnp.asarray(b2a_seed, jnp.uint32), B * W).reshape(B, W)
+    r0 = field.sample(r_words)
+    r1 = field.add(r0, field.from_int(1))
+    w0, w1 = field_to_words(field, r0), field_to_words(field, r1)
+    m0 = jnp.where(mask[:, None], w0, w1)
+    m1 = jnp.where(mask[:, None], w1, w0)
+    return m0 ^ pad0, m1 ^ pad1, r1
+
+
+def ev_step4(rcv: otext.OtExtReceiver, t2_rows, idx0, c0, c1, e_bits, field):
+    """Evaluator: decrypt its chosen payload -> field values [B] (its
+    additive shares: r0 where equal, r1 where not)."""
+    W = payload_words(field)
+    pad = rcv.pads(jnp.asarray(t2_rows), W, idx0)
+    e = jnp.asarray(e_bits, bool)
+    ct = jnp.where(e[:, None], jnp.asarray(c1), jnp.asarray(c0))
+    return words_to_field(field, ct ^ pad)
+
+
+# ---------------------------------------------------------------------------
+# Alive-gated per-node share sums (collect.rs:487-501)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("field",))
+def node_share_sums(field, vals, weight) -> jax.Array:
+    """vals: field elements [F, C, N(, limbs)]; weight: bool[F, C, N].
+    Returns per-(node, pattern) share sums [F, C(, limbs)].  Dead clients
+    and dead nodes are gated to zero — identically on both servers, since
+    liveness flags and the frontier alive mask are public protocol state
+    (ref: collect.rs:495)."""
+    if field.limb_shape:
+        vals = jnp.where(weight[..., None], vals, 0)
+        return field.sum(vals, axis=2)
+    vals = jnp.where(weight, vals, 0)
+    return field.sum(vals, axis=2)
+
+
+def alive_weight(alive_nodes, alive_keys, C: int) -> np.ndarray:
+    """bool[F, C, N] gating weight from the public liveness masks."""
+    a_n = np.asarray(alive_nodes, bool)
+    a_k = np.asarray(alive_keys, bool)
+    return np.broadcast_to(
+        a_n[:, None, None] & a_k[None, None, :], (a_n.shape[0], C, a_k.shape[0])
+    )

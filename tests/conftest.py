@@ -37,6 +37,16 @@ if "cpu" not in _plats.split(","):
 else:
     jax.config.update("jax_platforms", _plats)
 
+# Persistent compilation cache: the suite is compile-bound (many small
+# programs), so repeat runs should pay XLA compile costs once per machine.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR", os.path.expanduser("~/.cache/jax_fhh")
+    ),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -44,6 +54,17 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)
+
+
+@pytest.fixture
+def cpu_default(cpu_devices):
+    """Pin a test to the CPU backend.  Unit-scale tests use this: every
+    remote TPU compile costs ~10 s through the tunnel regardless of program
+    size, so compile-bound unit tests run on XLA:CPU (fast since the ChaCha
+    fusion fence, ops/prg.py) while the protocol e2e tests keep exercising
+    the real device."""
+    with jax.default_device(cpu_devices[0]):
+        yield
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,52 @@
+"""Chou-Orlandi base-OT tests: seed agreement, sender-side secrecy of the
+unchosen seed, and protocol-boundary input validation."""
+
+import numpy as np
+import pytest
+
+from fuzzyheavyhitters_tpu.ops import baseot
+
+
+def test_seed_agreement(rng):
+    choices = rng.integers(0, 2, size=16).astype(bool)
+    s0, s1, chosen = baseot.exchange(choices)
+    want = np.where(choices[:, None], s1, s0)
+    np.testing.assert_array_equal(chosen, want)
+
+
+def test_unchosen_seed_differs(rng):
+    choices = rng.integers(0, 2, size=8).astype(bool)
+    s0, s1, chosen = baseot.exchange(choices)
+    other = np.where(choices[:, None], s0, s1)
+    assert not np.any(np.all(chosen == other, axis=1))
+
+
+def test_seeds_index_separated():
+    """Same choice bits, but per-index seeds are pairwise distinct — the OT
+    index is folded into the seed hash (domain separation)."""
+    choices = np.zeros(8, bool)
+    s0, s1, chosen = baseot.exchange(choices)
+    for arr in (s0, s1):
+        assert len({row.tobytes() for row in arr}) == len(arr)
+
+
+def test_decompress_rejects_malformed():
+    with pytest.raises(ValueError, match="not a square|out of range"):
+        baseot.decompress(b"\x02" + b"\x00" * 31)  # y=2: not on curve
+    with pytest.raises(ValueError, match="out of range"):
+        baseot.decompress(b"\xff" * 32)  # y >= p
+    # a valid point still decodes
+    p = baseot.decompress(baseot._compress(baseot.BASE))
+    assert baseot._affine(p) == baseot._affine(baseot.BASE)
+
+
+def test_message_passing_api_matches_exchange(rng):
+    """The explicit two-round message API (what the socket handshake uses)
+    agrees with the in-process convenience wrapper's contract."""
+    choices = rng.integers(0, 2, size=4).astype(bool)
+    sender = baseot.BaseOtSender()
+    receiver = baseot.BaseOtReceiver(choices)
+    r_msgs = receiver.round1(sender.round1())
+    s0, s1 = sender.seeds([baseot.decompress(m) for m in r_msgs])
+    chosen = receiver.seeds()
+    np.testing.assert_array_equal(chosen, np.where(choices[:, None], s1, s0))

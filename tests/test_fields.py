@@ -12,6 +12,13 @@ import jax.numpy as jnp
 
 from fuzzyheavyhitters_tpu.ops.fields import FE62, F255
 
+
+@pytest.fixture(autouse=True)
+def _module_cpu(cpu_default):
+    """Unit-scale module: run on the CPU backend (see conftest)."""
+    yield
+
+
 P62 = FE62.P
 P255 = F255.P
 

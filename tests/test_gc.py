@@ -1,0 +1,84 @@
+"""Garbled-circuit equality tests vs the plaintext oracle, both roles in one
+process — the reference's socketpair 2PC test shape (ref:
+src/equalitytest.rs:222-266 ``eq_gc``), with the label hand-off done
+directly from GarblerSecrets (the explicit-OT form) and via the Δ-OT
+correlation (the fused form used by the live data plane)."""
+
+import numpy as np
+import pytest
+
+from fuzzyheavyhitters_tpu.ops import gc, otext
+
+
+@pytest.fixture(autouse=True)
+def _module_cpu(cpu_default):
+    """All tests in this module run on the CPU backend (see conftest)."""
+    yield
+
+
+def _strings(rng, B, S):
+    x = rng.integers(0, 2, size=(B, S)).astype(bool)
+    y = x.copy()
+    flip = rng.integers(0, 2, size=B).astype(bool)
+    y[flip, rng.integers(0, S, size=B)[flip]] ^= True
+    return x, y, np.all(x == y, axis=1)
+
+
+@pytest.mark.parametrize("S", [1, 2, 12, 33])
+def test_garble_eval_roundtrip(rng, S):
+    """mask ^ decoded == [x == y] for every batch entry (the contract of
+    multiple_gb/ev_equality_test, equalitytest.rs:25-106)."""
+    B = 16
+    x, y, eq = _strings(rng, B, S)
+    seed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    batch, secrets = gc.garble_equality(seed, x)
+    ev_labels = np.where(
+        y[..., None], np.asarray(secrets.ev_label1), np.asarray(secrets.ev_label0)
+    )
+    out = np.asarray(gc.eval_equality(batch, ev_labels))
+    np.testing.assert_array_equal(np.asarray(secrets.mask) ^ out, eq)
+
+
+def test_mask_distribution(rng):
+    """Output masks are per-test random bits, not constants — the garbler's
+    share must hide the plaintext result (equalitytest.rs:38-43)."""
+    B, S = 256, 4
+    x = rng.integers(0, 2, size=(B, S)).astype(bool)
+    seed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    _, secrets = gc.garble_equality(seed, x)
+    m = np.asarray(secrets.mask)
+    assert m.any() and not m.all()
+    # and masks differ across seeds
+    _, secrets2 = gc.garble_equality(seed + 1, x)
+    assert not np.array_equal(m, np.asarray(secrets2.mask))
+
+
+def test_wrong_label_wrong_answer(rng):
+    """Evaluating with a corrupted input label yields garbage, not the
+    correct equality bit — sanity check that the tables actually bind."""
+    B, S = 64, 8
+    x, y, eq = _strings(rng, B, S)
+    seed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    batch, secrets = gc.garble_equality(seed, x)
+    ev_labels = np.where(
+        y[..., None], np.asarray(secrets.ev_label1), np.asarray(secrets.ev_label0)
+    ).copy()
+    ev_labels[:, 0, :] ^= 0xDEADBEEF  # corrupt wire 0 everywhere
+    out = np.asarray(gc.eval_equality(batch, ev_labels))
+    assert not np.array_equal(np.asarray(secrets.mask) ^ out, eq)
+
+
+def test_delta_garble_matches_plain(rng):
+    """The Δ-OT form: labels delivered as T_j = Q_j ^ y_j*s must evaluate to
+    the same shared equality as the explicit form."""
+    snd, rcv = otext.inprocess_pair()
+    B, S = 33, 6
+    x, y, eq = _strings(rng, B, S)
+    u, t_rows = rcv.extend(y.reshape(B * S))
+    q = snd.extend(B * S, np.asarray(u))
+    seed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    batch, mask = gc.garble_equality_delta(
+        snd.s_block, np.asarray(q).reshape(B, S, 4), seed, x
+    )
+    out = np.asarray(gc.eval_equality(batch, np.asarray(t_rows).reshape(B, S, 4)))
+    np.testing.assert_array_equal(np.asarray(mask) ^ out, eq)

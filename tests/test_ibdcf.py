@@ -21,6 +21,13 @@ from fuzzyheavyhitters_tpu.ops.ibdcf import IbDcfKeyBatch
 from fuzzyheavyhitters_tpu.utils import bits as bitutils
 
 
+@pytest.fixture(autouse=True)
+def _module_cpu(cpu_default):
+    """Unit-scale module: run on the CPU backend (see conftest)."""
+    yield
+
+
+
 def key_from_oracle(k: oracle.IbDcfKey) -> ibdcf.IbDcfKeyBatch:
     return ibdcf.IbDcfKeyBatch(
         key_idx=np.asarray(k.key_idx),

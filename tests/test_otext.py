@@ -1,0 +1,95 @@
+"""IKNP OT-extension tests: Δ-OT invariant, chosen-payload delivery,
+stream-counter lockstep, and receiver privacy basics."""
+
+import numpy as np
+import pytest
+
+from fuzzyheavyhitters_tpu.ops import otext
+
+
+@pytest.fixture(autouse=True)
+def _module_cpu(cpu_default):
+    """All tests in this module run on the CPU backend (see conftest)."""
+    yield
+
+
+@pytest.fixture(scope="module")
+def pair():
+    return otext.inprocess_pair()
+
+
+def test_delta_ot_invariant(pair, rng):
+    """T_j == Q_j ^ r_j*s — rows are correlated exactly by the sender's s
+    (the free-XOR/Δ-OT contract the GC layer builds on)."""
+    snd, rcv = pair
+    m = 77
+    r = rng.integers(0, 2, size=m).astype(bool)
+    u, t = rcv.extend(r)
+    q = snd.extend(m, np.asarray(u))
+    s = snd.s_block
+    want = np.where(r[:, None], np.asarray(q) ^ s, np.asarray(q))
+    np.testing.assert_array_equal(np.asarray(t), want)
+
+
+def test_chosen_payload_roundtrip(pair, rng):
+    snd, rcv = pair
+    m = 65
+    r = rng.integers(0, 2, size=m).astype(bool)
+    idx0 = rcv._recv
+    u, t = rcv.extend(r)
+    q = snd.extend(m, np.asarray(u))
+    p0, p1 = snd.pads(q, 4, idx0)
+    pr = rcv.pads(t, 4, idx0)
+    m0 = rng.integers(0, 2**32, size=(m, 4), dtype=np.uint32)
+    m1 = rng.integers(0, 2**32, size=(m, 4), dtype=np.uint32)
+    c0 = m0 ^ np.asarray(p0)
+    c1 = m1 ^ np.asarray(p1)
+    got = np.where(r[:, None], c1, c0) ^ np.asarray(pr)
+    np.testing.assert_array_equal(got, np.where(r[:, None], m1, m0))
+
+
+def test_unchosen_pad_unlearnable(pair, rng):
+    """The receiver's pad never matches the sender's other-message pad —
+    (statistically: 2^-128 collision) — so the unchosen payload stays hidden."""
+    snd, rcv = pair
+    m = 40
+    r = rng.integers(0, 2, size=m).astype(bool)
+    idx0 = rcv._recv
+    u, t = rcv.extend(r)
+    q = snd.extend(m, np.asarray(u))
+    p0, p1 = snd.pads(q, 4, idx0)
+    pr = np.asarray(rcv.pads(t, 4, idx0))
+    other = np.where(r[:, None], np.asarray(p0), np.asarray(p1))
+    assert not np.any(np.all(pr == other, axis=1))
+
+
+def test_counter_lockstep(pair, rng):
+    """Back-to-back extensions stay correct (column streams advance in
+    lockstep) and produce fresh correlations."""
+    snd, rcv = pair
+    outs = []
+    for m in (33, 32, 7):
+        r = rng.integers(0, 2, size=m).astype(bool)
+        u, t = rcv.extend(r)
+        q = snd.extend(m, np.asarray(u))
+        want = np.where(r[:, None], np.asarray(q) ^ snd.s_block, np.asarray(q))
+        np.testing.assert_array_equal(np.asarray(t), want)
+        outs.append(np.asarray(q)[:7])
+    assert not np.array_equal(outs[0], outs[1])
+    assert not np.array_equal(outs[1], outs[2])
+
+
+def test_pack_unpack_roundtrip(rng):
+    for m in (1, 31, 32, 33, 128, 129):
+        bits = rng.integers(0, 2, size=m).astype(bool)
+        words = np.asarray(otext.pack_bits(bits))
+        assert words.shape == (-(-m // 32),)
+        np.testing.assert_array_equal(
+            np.asarray(otext.unpack_bits(words, m)), bits
+        )
+
+
+def test_fresh_s_bits_lsb_forced():
+    s = otext.fresh_s_bits()
+    assert s.shape == (128,) and s[0]
+    assert otext.s_to_block(s)[0] & 1 == 1

@@ -3,8 +3,16 @@ length-doubling interface), statistical sanity (ref test model: prg.rs:337-373
 non-degeneracy tests)."""
 
 import numpy as np
+import pytest
 
 from fuzzyheavyhitters_tpu.ops import prg
+
+
+@pytest.fixture(autouse=True)
+def _module_cpu(cpu_default):
+    """Unit-scale module: run on the CPU backend (see conftest)."""
+    yield
+
 
 
 def test_jax_matches_numpy_block(rng):

@@ -11,6 +11,14 @@ from fuzzyheavyhitters_tpu.protocol import collect, driver
 from fuzzyheavyhitters_tpu.utils import bits as bitutils
 
 
+@pytest.fixture(autouse=True)
+def _module_cpu(cpu_default):
+    """Colocated-driver e2e on the CPU backend: the same flow runs against
+    the real device in tests/test_rpc.py; duplicating it on the tunnel
+    costs ~10 s per compile (see conftest)."""
+    yield
+
+
 def brute_force_hitters(pts, ball, L, thresh):
     """All leaves x where #{clients whose saturated L∞ ball contains x} >=
     thresh, with exact counts.  pts: int[N, d]."""

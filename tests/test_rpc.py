@@ -106,7 +106,7 @@ def test_reset_clears_state(rng):
         cfg = _cfg()
         s0 = rpc.CollectorServer(0, cfg)
         pts_bits = np.array([[bitutils.int_to_bits(6, 20)]])
-        k0, _ = ibdcf.gen_l_inf_ball(pts_bits, 1, rng)
+        k0, _ = ibdcf.gen_l_inf_ball(pts_bits, 1, rng, engine="np")
         for _ in range(2):
             await s0.reset({})
             await s0.add_keys({"keys": tuple(np.asarray(x) for x in k0)})

@@ -1,0 +1,219 @@
+"""Secure data-plane tests: the GC+OT 2PC pipeline sans-IO, the string
+extraction's equivalence with the trusted compare, and a full two-server
+socket run in secure mode that must (a) match trusted-mode heavy hitters
+bit-for-bit and (b) never send a packed share-bit tensor to the peer."""
+
+import asyncio
+import secrets as pysecrets
+
+import numpy as np
+import pytest
+
+from fuzzyheavyhitters_tpu.ops import gc, ibdcf, otext
+from fuzzyheavyhitters_tpu.ops.fields import F255, FE62
+from fuzzyheavyhitters_tpu.protocol import collect, driver, rpc, secure
+from fuzzyheavyhitters_tpu.protocol.leader_rpc import RpcLeader
+from fuzzyheavyhitters_tpu.utils import bits as bitutils
+from fuzzyheavyhitters_tpu.utils.config import Config
+
+import jax.numpy as jnp
+
+
+@pytest.fixture(autouse=True)
+def _module_cpu(cpu_default):
+    """All tests in this module run on the CPU backend (see conftest)."""
+    yield
+
+
+@pytest.fixture(scope="module")
+def ot_pair():
+    return otext.inprocess_pair()
+
+
+@pytest.mark.parametrize("field", [FE62, F255], ids=["FE62", "F255"])
+def test_pipeline_sans_io(ot_pair, rng, field):
+    """garble -> Δ-OT labels -> eval -> b2a: v0 - v1 == [x == y] per test
+    (the r1-r0=1 trick, ref: collect.rs:439-471; F255 payloads ride two
+    blocks — the BlockPair double OT of collect.rs:775-916)."""
+    snd, rcv = ot_pair
+    B, S = 65, 4
+    x = rng.integers(0, 2, size=(B, S)).astype(bool)
+    y = x.copy()
+    flip = rng.integers(0, 2, size=B).astype(bool)
+    y[flip, rng.integers(0, S, size=B)[flip]] ^= True
+    eq = np.all(x == y, axis=1)
+
+    gc_seed = np.frombuffer(pysecrets.token_bytes(16), "<u4")
+    b2a_seed = np.frombuffer(pysecrets.token_bytes(16), "<u4")
+    u, t_rows = secure.ev_step1(rcv, y)
+    batch, mask = secure.gb_step1(snd, np.asarray(u), x, gc_seed)
+    e = secure.ev_step2(batch, t_rows, B, S)
+    np.testing.assert_array_equal(np.asarray(mask) ^ np.asarray(e), eq)
+    u2, t2, idx0 = secure.ev_step3(rcv, np.asarray(e))
+    c0, c1, v0 = secure.gb_step2(snd, np.asarray(u2), mask, b2a_seed, field)
+    v1 = secure.ev_step4(rcv, t2, idx0, np.asarray(c0), np.asarray(c1), e, field)
+    diff = np.asarray(field.canon(field.sub(v0, v1)))
+    if field is F255:
+        np.testing.assert_array_equal(diff[:, 0], eq.astype(np.uint32))
+        assert not diff[:, 1:].any()
+    else:
+        np.testing.assert_array_equal(diff, eq.astype(np.uint64))
+
+
+def test_evaluator_share_is_masked(ot_pair, rng):
+    """The evaluator's GC output alone must not reveal equality: its share
+    differs from the plaintext wherever the garbler's mask bit is set."""
+    snd, rcv = ot_pair
+    B, S = 128, 2
+    x = rng.integers(0, 2, size=(B, S)).astype(bool)
+    u, t_rows = secure.ev_step1(rcv, x)  # y == x: all equal
+    gc_seed = np.frombuffer(pysecrets.token_bytes(16), "<u4")
+    batch, mask = secure.gb_step1(snd, np.asarray(u), x, gc_seed)
+    e = np.asarray(secure.ev_step2(batch, t_rows, B, S))
+    m = np.asarray(mask)
+    assert m.any() and not m.all()
+    np.testing.assert_array_equal(e, ~m)  # eq=1 everywhere -> e = 1 ^ mask
+
+
+def test_child_strings_match_pattern_masks(rng):
+    """String equality on extracted per-pattern strings ⇔ the packed-mask
+    compare used by the trusted path (same membership predicate)."""
+    d = 2
+    F, N = 5, 17
+    p0 = rng.integers(0, 1 << (4 * d), size=(F, N), dtype=np.uint32)
+    p1 = rng.integers(0, 1 << (4 * d), size=(F, N), dtype=np.uint32)
+    # force some exact agreements
+    p1[:, ::3] = p0[:, ::3]
+    s0 = np.asarray(secure.child_strings(jnp.asarray(p0), d))  # [F,C,N,S]
+    s1 = np.asarray(secure.child_strings(jnp.asarray(p1), d))
+    eq_strings = np.all(s0 == s1, axis=-1)  # [F, C, N]
+    masks = collect.pattern_masks(d)
+    diff = p0 ^ p1
+    eq_masks = (diff[:, None, :] & masks[None, :, None]) == 0
+    np.testing.assert_array_equal(eq_strings, eq_masks)
+
+
+def test_node_share_sums_gating(rng):
+    vals = rng.integers(0, 100, size=(2, 2, 6)).astype(np.uint64)
+    w = np.ones((2, 2, 6), bool)
+    w[0, 0, 0] = False  # dead client contribution
+    w[1, :, :] = False  # dead node
+    out = np.asarray(secure.node_share_sums(FE62, jnp.asarray(vals), jnp.asarray(w)))
+    assert out[0, 0] == vals[0, 0, 1:].sum()
+    assert out[0, 1] == vals[0, 1].sum()
+    assert not out[1].any()
+
+
+# ---------------------------------------------------------------------------
+# Full two-server socket run in secure mode (ref test shape:
+# equalitytest.rs:222-266 — both roles in one process over a duplex pipe)
+# ---------------------------------------------------------------------------
+
+BASE_PORT = 39331
+
+
+def _cfg(port_base=BASE_PORT, **kw):
+    defaults = dict(
+        data_len=5,
+        n_dims=1,
+        ball_size=1,
+        addkey_batch_size=8,
+        num_sites=4,
+        threshold=0.2,
+        zipf_exponent=1.03,
+        server0=f"127.0.0.1:{port_base}",
+        server1=f"127.0.0.1:{port_base + 10}",
+        distribution="zipf",
+        f_max=32,
+    )
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+async def _run_protocol(cfg, keys0, keys1, nreqs):
+    s0 = rpc.CollectorServer(0, cfg)
+    s1 = rpc.CollectorServer(1, cfg)
+    host0, port0 = cfg.server0.rsplit(":", 1)
+    host1, port1 = cfg.server1.rsplit(":", 1)
+    port0, port1 = int(port0), int(port1)
+    peer_port = port1 + 1
+    t1 = asyncio.create_task(s1.start(host1, port1, host1, peer_port))
+    await asyncio.sleep(0.05)
+    t0 = asyncio.create_task(s0.start(host0, port0, host1, peer_port))
+    c0 = await rpc.CollectorClient.connect(host0, port0)
+    c1 = await rpc.CollectorClient.connect(host1, port1)
+    await asyncio.gather(t0, t1)
+    lead = RpcLeader(cfg, c0, c1)
+    await asyncio.gather(c0.call("reset"), c1.call("reset"))
+    await lead.upload_keys(keys0, keys1)
+    return await lead.run(nreqs)
+
+
+def _client_keys(rng, L, n):
+    pts = np.concatenate([np.full(n - 4, 11), rng.integers(0, 1 << L, size=4)])[
+        :, None
+    ]
+    pts_bits = np.array(
+        [[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts]
+    )
+    return ibdcf.gen_l_inf_ball(pts_bits, 1, rng, engine="np")
+
+
+def test_secure_socket_run_matches_trusted(rng, monkeypatch):
+    L, n = 5, 12
+    k0, k1 = _client_keys(rng, L, n)
+
+    # record every data/control-plane payload and every packed tensor
+    sent, packed_tensors = [], []
+    real_send = rpc._send
+    real_expand = collect.expand_share_bits
+
+    async def spy_send(writer, obj):
+        sent.append(obj)
+        await real_send(writer, obj)
+
+    def spy_expand(keys, frontier, level):
+        out = real_expand(keys, frontier, level)
+        packed_tensors.append(np.asarray(out))
+        return out
+
+    monkeypatch.setattr(rpc, "_send", spy_send)
+    monkeypatch.setattr(collect, "expand_share_bits", spy_expand)
+
+    cfg = _cfg(secure_exchange=True)
+    res = asyncio.run(_run_protocol(cfg, k0, k1, n))
+    got = {
+        tuple(int(v) for v in r): int(c)
+        for r, c in zip(res.decode_ints(), res.counts)
+    }
+
+    # trusted-mode oracle (colocated driver)
+    s0, s1 = driver.make_servers(k0, k1)
+    want_res = driver.Leader(s0, s1, n_dims=1, data_len=L, f_max=cfg.f_max).run(
+        nreqs=n, threshold=cfg.threshold
+    )
+    want = {
+        tuple(int(v) for v in r): int(c)
+        for r, c in zip(want_res.decode_ints(), want_res.counts)
+    }
+    assert got == want and got
+
+    # no packed share-bit tensor ever crossed a socket
+    assert packed_tensors
+    def leaves(obj):
+        if isinstance(obj, np.ndarray):
+            yield obj
+        elif isinstance(obj, (tuple, list)):
+            for o in obj:
+                yield from leaves(o)
+        elif isinstance(obj, dict):
+            for o in obj.values():
+                yield from leaves(o)
+
+    for obj in sent:
+        for leaf in leaves(obj):
+            for p in packed_tensors:
+                assert not (
+                    leaf.shape == p.shape and leaf.dtype == p.dtype
+                    and np.array_equal(leaf, p)
+                ), "packed share-bit tensor crossed the wire in secure mode"
