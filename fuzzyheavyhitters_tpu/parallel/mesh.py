@@ -24,6 +24,7 @@ so a full ``data_len``-level crawl compiles exactly two programs.
 
 from __future__ import annotations
 
+import secrets as _secrets
 from functools import partial
 
 import jax
@@ -31,13 +32,39 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops import prg
+from ..ops import baseot, gc, otext, prg
+from ..ops.fields import F255, FE62
 from ..ops.ibdcf import IbDcfKeyBatch
-from ..protocol import collect
+from ..protocol import collect, secure
 from ..protocol.collect import EvalState, Frontier
 
 SERVERS = "servers"
 DATA = "data"
+
+
+def field_psum(field, v, axis_name):
+    """Modular psum: sum field elements over a mesh axis without overflow.
+
+    FE62/U63 values are u64 scalars — a raw psum over k shards can exceed
+    2^64; splitting into 32-bit halves keeps every partial sum exact, then
+    recombines mod p (the collective twin of field.sum's split trick).
+    F255 limbs go through u64 so the k-way limb sums stay exact, then one
+    carry chain + 2^256 === 38 fold renormalizes."""
+    if field is F255:
+        l64 = jax.lax.psum(jnp.asarray(v, jnp.uint64), axis_name)
+        limbs, carry = F255._carry_chain(l64)
+        for _ in range(2):  # settle 2^256 === 38 wraps (cf. F255.mul's tail)
+            limbs, carry = F255._carry_chain(
+                limbs.at[..., 0].add(carry * jnp.uint64(38))
+            )
+        limbs = limbs.astype(jnp.uint32)
+        limbs = F255._sub_p_if(limbs, F255._geq_p(limbs))
+        return F255._sub_p_if(limbs, F255._geq_p(limbs))
+    mask32 = jnp.uint64(0xFFFFFFFF)
+    v = jnp.asarray(v, jnp.uint64)
+    lo = jax.lax.psum(v & mask32, axis_name)
+    hi = jax.lax.psum(v >> 32, axis_name)
+    return field.add(field.new(lo), field.mul(field.new(hi), field.from_int(1 << 32)))
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
@@ -63,9 +90,17 @@ class MeshRunner:
     modes mid-crawl.
     """
 
-    def __init__(self, mesh: Mesh, keys0: IbDcfKeyBatch, keys1: IbDcfKeyBatch, f_max: int):
+    def __init__(
+        self,
+        mesh: Mesh,
+        keys0: IbDcfKeyBatch,
+        keys1: IbDcfKeyBatch,
+        f_max: int,
+        secure_exchange: bool = False,
+    ):
         self.mesh = mesh
         self.f_max = f_max
+        self.secure = secure_exchange
         self.n_dims = keys0.cw_seed.shape[1]
         self.data_len = keys0.data_len
         self._derived = prg.DERIVED_BITS
@@ -98,7 +133,37 @@ class MeshRunner:
         )
         self.frontier: Frontier | None = None
         self._masks = collect.pattern_masks(self.n_dims)
+        self._kernel_cache: dict = {}
         self._build_kernels()
+        if secure_exchange:
+            self._setup_secure()
+
+    def _setup_secure(self):
+        """Host-side base-OT setup for the on-mesh 2PC: party 0 (garbler /
+        extension sender) gets its ``s``-chosen seeds, party 1 (evaluator)
+        the seed-pair columns.  The stacked [2, ...] tensors put each
+        party's material in its own mesh-row slot; the unused slots are
+        zeros (SPMD runs both roles on both parties and discards the
+        wrong-role half — branchless, like any 2-way-masked collective)."""
+        s_bits = otext.fresh_s_bits()
+        seeds0, seeds1, chosen = baseot.exchange(s_bits)
+        z = np.zeros((otext.KAPPA, 4), np.uint32)
+        put = lambda a, spec: jax.device_put(
+            a, NamedSharding(self.mesh, spec)
+        )
+        self._s_bits = put(
+            np.stack([s_bits, np.zeros_like(s_bits)]), P(SERVERS, None)
+        )
+        self._seeds_main = put(
+            np.stack([chosen, seeds0]).astype(np.uint32), P(SERVERS, None, None)
+        )
+        self._seeds_aux = put(
+            np.stack([z, seeds1]).astype(np.uint32), P(SERVERS, None, None)
+        )
+        self._ot_blocks = 0  # column-stream block offset (lockstep)
+        self._ot_sent = 0  # pad-tweak index base
+        self._sec_seed = np.frombuffer(_secrets.token_bytes(16), "<u4").copy()
+        self._crawl_ctr = 0
 
     def _build_kernels(self):
         mesh, f_max, derived = self.mesh, self.f_max, self._derived
@@ -154,6 +219,110 @@ class MeshRunner:
             )
         )
 
+    def _secure_counts_fn(self, field):
+        """Build (and cache) the one-program secure level crawl for a count
+        field: the whole GC+OT 2PC — label extension, garbling, evaluation,
+        b2a, alive-gated share sums — as a single shard_mapped program whose
+        only inter-party traffic is four ``ppermute`` transfers on the
+        ``servers`` axis (u-matrix, garbled batch, b2a u-matrix,
+        ciphertexts): the ICI twin of protocol/rpc.py's socket flow.
+
+        Per-data-shard uniqueness: every (0,j)<->(1,j) chip pair runs its
+        own extension on the shared base seeds.  Reusing identical column
+        streams / garbler randomness across shards would leak XORs of
+        secrets between shards (u_A ^ u_B = r_A ^ r_B, and identical X0
+        labels reveal x_A ^ x_B), so every seed is tweaked by the shard
+        index inside the body — consistently on both parties."""
+        key = ("secure", field.__name__)
+        if key not in self._kernel_cache:
+            self._kernel_cache[key] = self._make_secure_body(field)
+        return self._kernel_cache[key]
+
+    def _make_secure_body(self, field):
+        mesh, derived, d = self.mesh, self._derived, self.n_dims
+        kspec, fspec = self._key_spec, self._frontier_spec
+        limb = field.limb_shape
+
+        def body(keys, frontier, alive_keys, s_bits, seeds_main, seeds_aux,
+                 gc_seed, b2a_seed, off, sent, level):
+            keys_l = jax.tree.map(lambda a: a[0], keys)
+            frontier_l = jax.tree.map(lambda a: a[0], frontier)
+            alive = alive_keys[0]
+            s_bits_l, sm, sa = s_bits[0], seeds_main[0], seeds_aux[0]
+            gseed, bseed = gc_seed[0], b2a_seed[0]
+            # NB: never tweak word 0 — it is stream_blocks' CTR word, and a
+            # small XOR there yields a block-SHIFTED identical stream, not an
+            # independent one.  Word 3 is safe for the column seeds; the
+            # garbler seeds use word 2 shifted clear of derive_seed's
+            # purpose tag.
+            shard = jax.lax.axis_index(DATA).astype(jnp.uint32)
+            sm = sm.at[..., 3].set(sm[..., 3] ^ shard)
+            sa = sa.at[..., 3].set(sa[..., 3] ^ shard)
+            gseed = gseed.at[2].set(gseed[2] ^ (shard << 16))
+            bseed = bseed.at[2].set(bseed[2] ^ (shard << 16))
+
+            packed = collect._expand_share_bits_jit(keys_l, frontier_l, level, derived)
+            strs = secure.child_strings(packed, d)  # [F, C, Nl, S]
+            F_, C, Nl, S = strs.shape
+            B = F_ * C * Nl
+            m = B * S
+            flat = strs.reshape(B, S)
+
+            # label delivery: evaluator's u -> garbler; labels = Δ-OT rows
+            u, t_rows = otext._receiver_extend(sm, sa, flat.reshape(m), off, m)
+            u0 = jax.lax.ppermute(u, SERVERS, perm=[(1, 0)])
+            q = otext._sender_extend(sm, s_bits_l, u0, off, m)
+            s_block = otext.pack_bits(s_bits_l)
+            batch, mask = gc.garble_equality_delta(
+                s_block, q.reshape(B, S, 4), gseed, flat
+            )
+            ev_batch = gc.GarbledEqBatch(
+                tables=jax.lax.ppermute(batch.tables, SERVERS, perm=[(0, 1)]),
+                gb_labels=jax.lax.ppermute(batch.gb_labels, SERVERS, perm=[(0, 1)]),
+                decode=jax.lax.ppermute(batch.decode, SERVERS, perm=[(0, 1)]),
+            )
+            e = gc.eval_equality(ev_batch, t_rows.reshape(B, S, 4))
+
+            # b2a conversion (r1 - r0 = 1 trick) under chosen-payload pads
+            w_cols = -(-m // 32)
+            off2 = off + (-(-w_cols // 16))
+            u2, t2_rows = otext._receiver_extend(sm, sa, e, off2, B)
+            u2_0 = jax.lax.ppermute(u2, SERVERS, perm=[(1, 0)])
+            q2 = otext._sender_extend(sm, s_bits_l, u2_0, off2, B)
+            idx0 = sent + m
+            c0g, c1g, r1 = secure.b2a_encrypt(field, q2, s_block, mask, bseed, idx0)
+            c0 = jax.lax.ppermute(c0g, SERVERS, perm=[(0, 1)])
+            c1 = jax.lax.ppermute(c1g, SERVERS, perm=[(0, 1)])
+            v1 = secure.b2a_decrypt(field, t2_rows, idx0, c0, c1, e)
+
+            party = jax.lax.axis_index(SERVERS)
+            vals = jnp.where(party == 0, r1, v1)  # own additive share per test
+            wgt = (
+                frontier_l.alive[:, None, None]
+                & alive[None, None, :]
+            )
+            wgt = jnp.broadcast_to(wgt, (F_, C, Nl))
+            shares = secure.node_share_sums(
+                field, vals.reshape((F_, C, Nl) + limb), wgt
+            )
+            shares = field_psum(field, shares, DATA)
+            return shares[None]
+
+        out_spec = P(SERVERS, None, None, *([None] if limb else []))
+        fn = jax.jit(
+            jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(
+                    kspec, fspec, P(SERVERS, DATA), P(SERVERS, None),
+                    P(SERVERS, None, None), P(SERVERS, None, None),
+                    P(SERVERS, None), P(SERVERS, None), P(), P(), P(),
+                ),
+                out_specs=out_spec,
+            )
+        )
+        return fn
+
     # -- leader-facing ops --------------------------------------------------
 
     def tree_init(self):
@@ -167,6 +336,37 @@ class MeshRunner:
                 self.keys, self.frontier, self.alive_keys, jnp.int32(level)
             )
         )
+
+    def level_count_shares(self, level: int, field=FE62) -> np.ndarray:
+        """Secure crawl: both parties' additive count shares [2, F, 2^d
+        (, limbs)] — reconstruct as field.sub(shares[0], shares[1]).  The
+        level field mirrors the socket path: FE62 inner levels, F255 last
+        (ref: rpc.rs:60-62)."""
+        assert self.secure, "runner built without secure_exchange"
+        fn = self._secure_counts_fn(field)
+        self._crawl_ctr += 1
+        gseed = secure.derive_seed(self._sec_seed, 1, level, self._crawl_ctr)
+        bseed = secure.derive_seed(self._sec_seed, 2, level, self._crawl_ctr)
+        z = np.zeros(4, np.uint32)
+        put = lambda a: jax.device_put(
+            np.stack([a, z]), NamedSharding(self.mesh, P(SERVERS, None))
+        )
+        # static per-call shapes -> deterministic stream consumption
+        n_local = self.keys.cw_seed.shape[1] // self.mesh.shape[DATA]
+        B = self.f_max * (1 << self.n_dims) * n_local
+        m = B * 2 * self.n_dims
+        shares = fn(
+            self.keys, self.frontier, self.alive_keys,
+            self._s_bits, self._seeds_main, self._seeds_aux,
+            put(gseed), put(bseed),
+            jnp.uint32(self._ot_blocks), jnp.uint32(self._ot_sent),
+            jnp.int32(level),
+        )
+        w1 = -(-m // 32)
+        w2 = -(-B // 32)
+        self._ot_blocks += (-(-w1 // 16)) + (-(-w2 // 16))
+        self._ot_sent += m + B
+        return np.asarray(shares)
 
     def advance(self, level: int, parent_idx, pattern_bits, n_alive: int):
         self.frontier = self._advance_fn(
@@ -188,6 +388,23 @@ class MeshLeader:
         self.paths = None
         self.n_nodes = 0
 
+    def _level_counts(self, level: int) -> np.ndarray:
+        """Per-level counts: plaintext compare in trusted mode, or leader
+        reconstruction v0 - v1 of the parties' share outputs in secure mode
+        (FE62 inner levels, F255 last — ref: rpc.rs:60-62)."""
+        r = self.r
+        if not r.secure:
+            return r.level_counts(level)
+        if level == r.data_len - 1:
+            sh = r.level_count_shares(level, F255)
+            v = np.asarray(F255.sub(sh[0], sh[1]))
+            counts = v[..., 0].astype(np.uint32)
+            if np.any(v[..., 1:]):
+                raise RuntimeError("non-count residue in F255 mesh shares")
+            return counts
+        sh = r.level_count_shares(level, FE62)
+        return np.asarray(FE62.canon(FE62.sub(sh[0], sh[1]))).astype(np.uint32)
+
     def run(self, nreqs: int, threshold: float):
         from ..protocol.driver import CrawlResult
 
@@ -198,7 +415,7 @@ class MeshLeader:
         self.n_nodes = 1
         counts_kept = np.zeros(0, np.uint32)
         for level in range(r.data_len):
-            counts = r.level_counts(level)
+            counts = self._level_counts(level)
             thresh = max(1, int(threshold * nreqs))
             keep = counts >= thresh
             keep[self.n_nodes :, :] = False

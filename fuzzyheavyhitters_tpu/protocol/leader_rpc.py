@@ -66,7 +66,8 @@ class RpcLeader:
             if last:
                 v = np.asarray(F255.sub(s0, s1))  # leader-side reconstruct
                 counts = v[..., 0].astype(np.uint32)  # counts < 2^32 by def
-                assert not np.any(v[..., 1:]), "non-count residue in F255 share"
+                if np.any(v[..., 1:]):  # boundary check: must survive -O
+                    raise RuntimeError("non-count residue in F255 share")
             else:
                 counts = np.asarray(FE62.canon(FE62.sub(s0, s1))).astype(np.uint32)
             keep = counts >= thresh

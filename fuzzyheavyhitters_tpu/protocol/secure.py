@@ -143,18 +143,18 @@ def ev_step3(rcv: otext.OtExtReceiver, e_bits):
     return u2, t2, idx0
 
 
-def gb_step2(snd: otext.OtExtSender, u2_msg, mask, b2a_seed, field):
-    """Garbler: b2a conversion — sample (r0, r1 = r0+1), order by mask
-    (collect.rs:439-456), encrypt under the OT pads.
-
-    Returns (c0, c1 ciphertext words [B, W], v0 field values [B] — the
-    garbler's additive shares, always r1)."""
+def b2a_encrypt(field, q2_rows, s_block, mask, b2a_seed, idx0):
+    """Stateless b2a sender core: sample (r0, r1 = r0+1), order payloads by
+    ``mask`` (collect.rs:439-456), encrypt under the OT pads derived from
+    the Q rows.  Returns (c0, c1 ciphertext words [B, W], r1 — the sender's
+    additive shares).  Shared by the socket path (gb_step2) and the mesh
+    kernel (parallel/mesh.py) so the trick lives in exactly one place."""
     mask = jnp.asarray(mask, bool)
     B = mask.shape[0]
     W = payload_words(field)
-    idx0 = snd.consumed
-    q2 = snd.extend(B, u2_msg)
-    pad0, pad1 = snd.pads(q2, W, idx0)
+    q2_rows = jnp.asarray(q2_rows)
+    pad0 = otext.ot_hash(q2_rows, W, idx0)
+    pad1 = otext.ot_hash(q2_rows ^ jnp.asarray(s_block), W, idx0)
     r_words = prg.stream_words(jnp.asarray(b2a_seed, jnp.uint32), B * W).reshape(B, W)
     r0 = field.sample(r_words)
     r1 = field.add(r0, field.from_int(1))
@@ -164,14 +164,31 @@ def gb_step2(snd: otext.OtExtSender, u2_msg, mask, b2a_seed, field):
     return m0 ^ pad0, m1 ^ pad1, r1
 
 
-def ev_step4(rcv: otext.OtExtReceiver, t2_rows, idx0, c0, c1, e_bits, field):
-    """Evaluator: decrypt its chosen payload -> field values [B] (its
-    additive shares: r0 where equal, r1 where not)."""
+def b2a_decrypt(field, t2_rows, idx0, c0, c1, e_bits):
+    """Stateless b2a receiver core: decrypt the choice-side ciphertext with
+    the T-row pad -> field values (r0 where equal, r1 where not)."""
     W = payload_words(field)
-    pad = rcv.pads(jnp.asarray(t2_rows), W, idx0)
+    pad = otext.ot_hash(jnp.asarray(t2_rows), W, idx0)
     e = jnp.asarray(e_bits, bool)
     ct = jnp.where(e[:, None], jnp.asarray(c1), jnp.asarray(c0))
     return words_to_field(field, ct ^ pad)
+
+
+def gb_step2(snd: otext.OtExtSender, u2_msg, mask, b2a_seed, field):
+    """Garbler: extend the b2a OT and run :func:`b2a_encrypt`.
+
+    Returns (c0, c1 ciphertext words [B, W], v0 field values [B] — the
+    garbler's additive shares, always r1)."""
+    B = jnp.asarray(mask).shape[0]
+    idx0 = snd.consumed
+    q2 = snd.extend(B, u2_msg)
+    return b2a_encrypt(field, q2, snd.s_block, mask, b2a_seed, idx0)
+
+
+def ev_step4(rcv: otext.OtExtReceiver, t2_rows, idx0, c0, c1, e_bits, field):
+    """Evaluator: decrypt its chosen payload -> field values [B] (its
+    additive shares: r0 where equal, r1 where not)."""
+    return b2a_decrypt(field, t2_rows, idx0, c0, c1, e_bits)
 
 
 # ---------------------------------------------------------------------------

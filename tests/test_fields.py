@@ -129,3 +129,124 @@ def test_share_reconstruct_roundtrip(rng):
             s1 = F.from_int(r)
         rec = F.to_numpy_ints(F.sub(s0, s1))
         assert int(rec) == v
+
+
+# ---------------------------------------------------------------------------
+# Round-2 surface: mul/recip laws vs Python bignums, U63, Dummy, Block codecs
+# (ref law-test templates: fastfield.rs:432-559, field.rs:495-623)
+# ---------------------------------------------------------------------------
+
+from fuzzyheavyhitters_tpu.ops.fields import U63, Dummy  # noqa: E402
+
+P63 = U63.P
+
+
+def test_f255_mul_vs_bignum(rng):
+    """8x8-limb mul incl. p-1, fold-boundary (values near 2^256/38 wrap) and
+    random pairs — every product checked against exact Python ints."""
+    xs = EDGE255 + [int.from_bytes(rng.bytes(32), "little") % P255 for _ in range(40)]
+    ys = list(reversed(xs))
+    a, b = _f255_from_ints(xs), _f255_from_ints(ys)
+    got = F255.to_numpy_ints(F255.mul(a, b))
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        assert int(got[i]) == (x * y) % P255, (x, y)
+
+
+def test_f255_mul_field_laws(rng):
+    xs = [int.from_bytes(rng.bytes(32), "little") % P255 for _ in range(8)]
+    a = _f255_from_ints(xs)
+    one = F255.from_int(1)
+    # identity, commutativity, distributivity
+    np.testing.assert_array_equal(np.asarray(F255.mul(a, one)), np.asarray(a))
+    b = _f255_from_ints(list(reversed(xs)))
+    np.testing.assert_array_equal(
+        np.asarray(F255.mul(a, b)), np.asarray(F255.mul(b, a))
+    )
+    c = _f255_from_ints([(x * 7 + 3) % P255 for x in xs])
+    lhs = F255.mul(a, F255.add(b, c))
+    rhs = F255.add(F255.mul(a, b), F255.mul(a, c))
+    np.testing.assert_array_equal(np.asarray(lhs), np.asarray(rhs))
+
+
+def test_f255_recip(rng):
+    xs = [1, 2, 19, P255 - 1] + [
+        int.from_bytes(rng.bytes(32), "little") % P255 for _ in range(6)
+    ]
+    xs = [x for x in xs if x != 0]
+    a = _f255_from_ints(xs)
+    prod = F255.to_numpy_ints(F255.mul(a, F255.recip(a)))
+    assert all(int(p) == 1 for p in prod)
+    # convention: recip(0) = 0
+    z = F255.recip(F255.from_int(0))
+    assert int(F255.to_numpy_ints(z)) == 0
+
+
+def test_fe62_recip(rng):
+    xs = [1, 2, P62 - 1, (1 << 30), (1 << 30) + 1] + [
+        int(rng.integers(1, P62)) for _ in range(10)
+    ]
+    a = jnp.array(xs, jnp.uint64)
+    prod = FE62.to_numpy_ints(FE62.mul(a, FE62.recip(a)))
+    assert all(int(p) == 1 for p in prod)
+    assert int(FE62.to_numpy_ints(FE62.recip(FE62.from_int(0)))) == 0
+
+
+def test_u63_laws_vs_bignum(rng):
+    """The reference's u64 group (MODULUS_64 = 2^63 - 25, field.rs:25-26)."""
+    edge = [0, 1, 25, P63 - 1, P63 - 25, P63 // 2, (1 << 62)]
+    xs = edge + [int(rng.integers(0, P63)) for _ in range(40)]
+    ys = list(reversed(xs))
+    a = jnp.array(xs, jnp.uint64)
+    b = jnp.array(ys, jnp.uint64)
+    got_add = U63.to_numpy_ints(U63.add(a, b))
+    got_sub = U63.to_numpy_ints(U63.sub(a, b))
+    got_mul = U63.to_numpy_ints(U63.mul(a, b))
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        assert int(got_add[i]) == (x + y) % P63
+        assert int(got_sub[i]) == (x - y) % P63
+        assert int(got_mul[i]) == (x * y) % P63, (x, y)
+
+
+def test_u63_sum_and_sample(rng):
+    xs = [int(rng.integers(0, P63)) for _ in range(500)]
+    got = int(U63.to_numpy_ints(U63.sum(jnp.array(xs, jnp.uint64), axis=0)))
+    assert got == sum(xs) % P63
+    words = jnp.array(rng.integers(0, 2**32, size=(128, 4)), jnp.uint32)
+    vals = U63.to_numpy_ints(U63.sample(words))
+    assert all(int(v) < P63 for v in vals)
+    assert len(set(vals.tolist())) > 120
+
+
+def test_dummy_group_is_inert(rng):
+    a = Dummy.zeros((5,))
+    assert not np.asarray(Dummy.add(a, a)).any()
+    assert not np.asarray(Dummy.mul(a, a)).any()
+    assert np.asarray(Dummy.eq(a, a)).all()
+    assert not np.asarray(Dummy.sample(jnp.zeros((5, 4), jnp.uint32))).any()
+    assert not np.asarray(Dummy.sum(jnp.zeros((3, 5), jnp.uint32), axis=0)).any()
+
+
+def test_fe62_block_roundtrip(rng):
+    """Block codec (OT payload format, ref: fastfield.rs:414-431)."""
+    xs = EDGE62 + [int(rng.integers(0, P62)) for _ in range(20)]
+    v = jnp.array(xs, jnp.uint64)
+    blocks = FE62.to_blocks(v)
+    assert blocks.shape == (len(xs), 4)
+    back = FE62.to_numpy_ints(FE62.from_blocks(blocks))
+    np.testing.assert_array_equal(back, np.array(xs, np.uint64))
+    # high words fold mod p rather than being rejected
+    hi = jnp.array([[1, 0, 1, 0]], jnp.uint32)
+    folded = FE62.to_numpy_ints(FE62.from_blocks(hi))
+    assert int(folded[0]) == (1 + (1 << 64)) % P62
+
+
+def test_f255_blockpair_roundtrip(rng):
+    """BlockPair codec (ref: field.rs:465-492 — F255 OT payloads are two
+    128-bit blocks)."""
+    xs = EDGE255 + [int.from_bytes(rng.bytes(32), "little") % P255 for _ in range(10)]
+    v = _f255_from_ints(xs)
+    blocks = F255.to_blocks(v)
+    assert blocks.shape == (len(xs), 2, 4)
+    back = F255.to_numpy_ints(F255.from_blocks(blocks))
+    for i, x in enumerate(xs):
+        assert int(back[i]) == x
