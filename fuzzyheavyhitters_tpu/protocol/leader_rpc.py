@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 
+import jax
 import numpy as np
 
 from ..ops.fields import F255, FE62
@@ -31,20 +32,41 @@ class RpcLeader:
         self.c0, self.c1 = client0, client1
         self.paths: np.ndarray | None = None
         self.n_nodes = 0
+        self.has_sketch = False
 
     async def _both(self, verb: str, req=None):
         return await asyncio.gather(self.c0.call(verb, req), self.c1.call(verb, req))
 
-    async def upload_keys(self, keys0: IbDcfKeyBatch, keys1: IbDcfKeyBatch):
+    async def upload_keys(
+        self,
+        keys0: IbDcfKeyBatch,
+        keys1: IbDcfKeyBatch,
+        sketch0=None,
+        sketch1=None,
+    ):
         """Batched async key upload (ref: leader.rs:340-364: addkey batches
-        with bounded in-flight concurrency)."""
+        with bounded in-flight concurrency).  Optional sketch key batches
+        ride in the same requests (malicious-secure mode)."""
         n = np.asarray(keys0.cw_seed).shape[0]
         bs = max(1, self.cfg.addkey_batch_size)
+        self.has_sketch = sketch0 is not None
+
+        def sk_chunk(sk, sl):
+            if sk is None:
+                return None
+            return [np.asarray(x)[sl] for x in jax.tree.leaves(sk)]
+
         pending = []
         for lo in range(0, n, bs):
             sl = slice(lo, min(lo + bs, n))
-            pending.append(self.c0.call("add_keys", {"keys": _key_chunk(keys0, sl)}))
-            pending.append(self.c1.call("add_keys", {"keys": _key_chunk(keys1, sl)}))
+            pending.append(self.c0.call(
+                "add_keys",
+                {"keys": _key_chunk(keys0, sl), "sketch": sk_chunk(sketch0, sl)},
+            ))
+            pending.append(self.c1.call(
+                "add_keys",
+                {"keys": _key_chunk(keys1, sl), "sketch": sk_chunk(sketch1, sl)},
+            ))
             if len(pending) >= 16:  # bounded in-flight window
                 await asyncio.gather(*pending)
                 pending = []
@@ -61,6 +83,13 @@ class RpcLeader:
         counts_kept = np.zeros(0, np.uint32)
         for level in range(L):
             last = level == L - 1
+            if self.has_sketch and level >= 1:
+                # malicious-security gate first: the frontier-following
+                # sketch shares stored by the previous prune are verified,
+                # so failing clients' liveness flags flip before this
+                # level's counts are taken (depth-0 has a single root node
+                # — nothing to verify yet)
+                await self._both("sketch_verify", {"level": level})
             verb = "tree_crawl_last" if last else "tree_crawl"
             s0, s1 = await self._both(verb, {"level": level})
             if last:
@@ -105,6 +134,13 @@ class RpcLeader:
             self.paths = new_paths
             self.n_nodes = n_alive
             counts_kept = counts[parent[:n_alive], pattern[:n_alive]]
+        if self.has_sketch:
+            # final F255 leaf-payload check (surviving leaves; counts for
+            # this collection are already taken — the verdict gates the
+            # liveness flags for any further use and flags forged leaves)
+            a0, a1 = await self._both("sketch_verify", {"level": L})
+            if not (np.asarray(a0).all() and np.asarray(a1).all()):
+                print("WARNING: forged sketch leaf payload detected")
         # final reconstruction from re-served leaf shares: v0 - v1 per
         # surviving leaf (ref: collect.rs:993-1029 final_shares/final_values;
         # the crawl-time counts are only the pruning signal)

@@ -1,0 +1,283 @@
+"""Malicious-secure sketch: MAC'd payload DPFs + sketch inner products.
+
+Resurrection of the reference's commented-out sketch layer (ref:
+src/sketch.rs:8-245) as a live TPU component, per the north star.  A
+client encodes its contribution as a payload DPF whose per-level value is
+the pair ``(x, k·x)`` for a per-client MAC key ``k`` (sketch.rs:8-24,
+79-130); each server holds additive shares of the one-hot value vector
+over the tree level.  The servers then compute three sketch inner
+products against a SHARED random vector r (their common seed plays the
+role of the reference's shared rand stream, sketch.rs:157-199):
+
+    r_x  = <r, x>,   r2_x = <r², x>,   r_kx = <r, k·x>
+
+— pure batched dot products over (clients × nodes), the MXU-friendly hot
+path — and verify with Beaver triples (protocol/mpc.py) that
+
+    1.  <r,x>² − <r²,x>        = 0     (one-hot / 0-1 vector check)
+    2.  k·k − k²               = 0     (MAC-key share consistency)
+    3.  k·<r,x> − <r,kx>       = 0     (MAC check: no additive attack)
+
+(the MulState recipe of mpc.rs:103-141).  Failures flip the per-client
+``alive_keys`` liveness flag that already gates every count
+(collect.rs:32, 495 — the hook upstream left for exactly this).
+
+Scope note, stated honestly: in the reference's *ancestor* the payload
+DPF was also the counting path, so the sketch protected the counts
+directly; the reference replaced that path with the GC+OT equality
+protocol and left the sketch dead.  Here the sketch runs as the
+malicious-security scaffold alongside the ibDCF path — same protocol,
+same checks, same liveness gate — over the 1-D string workloads the
+upstream sketch covered (a one-hot vector check does not extend to fuzzy
+L∞ balls, which contain many nodes per level).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import dpf, prg
+from ..ops.dpf import DpfEvalState, DpfKeyBatch
+from . import mpc
+
+LANES = 2  # payload lanes: (x, k·x)
+
+
+class SketchKeyBatch(NamedTuple):
+    """One party's sketch keys for N clients (ref: sketch.rs:14-24)."""
+
+    key: DpfKeyBatch
+    mac_key: jax.Array  # field_t share [N]
+    mac_key2: jax.Array  # field_t share of k² [N]
+    mac_key_last: jax.Array  # field_u share [N(, limbs)]
+    mac_key2_last: jax.Array
+    triples: mpc.TripleBatch  # field_t [N, L-1, CHECKS]
+    triples_last: mpc.TripleBatch  # field_u [N, CHECKS(, limbs)]
+
+
+class SketchOutput(NamedTuple):
+    """Per-client sketch inner products + shared linear-combination
+    coefficients (ref: sketch.rs:26-43)."""
+
+    r_x: jax.Array
+    r2_x: jax.Array
+    r_kx: jax.Array
+    rand1: jax.Array
+    rand2: jax.Array
+    rand3: jax.Array
+
+
+def gen(init_seeds, alpha_bits, field_t, field_u, seed) -> tuple[SketchKeyBatch, SketchKeyBatch]:
+    """Client-side keygen (ref: sketch.rs:79-149 ``gen``/``gen_from_str``):
+    unit payloads (x = 1 at the client's prefix) MAC'd with fresh per-client
+    keys; triples for every level's checks ride along.
+
+    init_seeds: uint32[N, 2, 4]; alpha_bits: bool[N, L]; seed: uint32[4]
+    client-side randomness (MAC keys, shares, triples).
+    """
+    alpha_bits = np.asarray(alpha_bits, bool)
+    N, L = alpha_bits.shape
+    wt = 4
+    wu = 8 if field_u.limb_shape else 4
+    seed = jnp.asarray(seed, jnp.uint32)
+
+    def sub_seed(tag):
+        return seed ^ jnp.asarray([0, 0, 0, tag], jnp.uint32)
+
+    # MAC keys + shares
+    k = field_t.sample(prg.stream_words(sub_seed(1), N * wt).reshape(N, wt))
+    k2 = field_t.mul(k, k)
+    k_last = field_u.sample(prg.stream_words(sub_seed(2), N * wu).reshape(N, wu))
+    k2_last = field_u.mul(k_last, k_last)
+
+    def share(field, v, tag):
+        w = 8 if field.limb_shape else 4
+        n = int(np.prod(v.shape[: v.ndim - len(field.limb_shape)]))
+        s0 = field.sample(prg.stream_words(sub_seed(tag), n * w).reshape(v.shape[: v.ndim - len(field.limb_shape)] + (w,)))
+        return s0, field.sub(v, s0)
+
+    k_s = share(field_t, k, 3)
+    k2_s = share(field_t, k2, 4)
+    kl_s = share(field_u, k_last, 5)
+    k2l_s = share(field_u, k2_last, 6)
+
+    # payload values: inner levels (1, k) in T; last level (1, k_last) in U
+    one_t = jnp.broadcast_to(field_t.from_int(1), (N,))
+    vals = jnp.stack([one_t, k], axis=-1)[:, None, :]  # [N, 1, 2]
+    vals = jnp.broadcast_to(vals, (N, L - 1, LANES))
+    one_u = jnp.broadcast_to(
+        field_u.from_int(1), (N,) + field_u.limb_shape
+    )
+    vals_last = jnp.stack([one_u, k_last], axis=1)  # [N, LANES(, limbs)]
+
+    dk0, dk1 = dpf.gen_pair(
+        init_seeds, alpha_bits, vals, vals_last, field_t, field_u, LANES
+    )
+
+    t0, t1 = mpc.gen_triples(field_t, (N, L - 1, mpc.CHECKS), sub_seed(7))
+    tl0, tl1 = mpc.gen_triples(field_u, (N, mpc.CHECKS), sub_seed(8))
+
+    def mk(p, dk, trip, trip_last):
+        return SketchKeyBatch(
+            key=dk,
+            mac_key=k_s[p],
+            mac_key2=k2_s[p],
+            mac_key_last=kl_s[p],
+            mac_key2_last=k2l_s[p],
+            triples=trip,
+            triples_last=trip_last,
+        )
+
+    return mk(0, dk0, t0, tl0), mk(1, dk1, t1, tl1)
+
+
+def shared_r_stream(field, shared_seed, level: int, m: int, n_clients: int):
+    """The servers' common sketch randomness for one level: per-node r_j
+    (and r_j²) plus per-client rand1..3 — both servers derive identical
+    values from the shared seed (the reference's shared rand_stream,
+    sketch.rs:164-168, seeded like server.rs:331-332)."""
+    w = 8 if field.limb_shape else 4
+    s = jnp.asarray(shared_seed, jnp.uint32) ^ jnp.asarray(
+        [0, 0, 0x5E71C, level], jnp.uint32
+    )
+    words = prg.stream_words(s, (m + 3 * n_clients) * w)
+    r = field.sample(words[: m * w].reshape((m, w)))
+    rands = field.sample(
+        words[m * w :].reshape((n_clients, 3, w))
+    )
+    return r, rands
+
+
+@partial(jax.jit, static_argnames=("field",))
+def sketch_output(field, pair_shares, r, rands) -> SketchOutput:
+    """Batched sketch inner products (ref: sketch.rs:157-199 sketch_at).
+
+    pair_shares: field[N, M, LANES(, limbs)] — this server's value-pair
+    shares over the M tree nodes of the level; r: field[M(, limbs)] shared
+    random vector; rands: field[N, 3(, limbs)].
+    """
+    x = pair_shares[..., 0] if not field.limb_shape else pair_shares[..., 0, :]
+    kx = pair_shares[..., 1] if not field.limb_shape else pair_shares[..., 1, :]
+    r2 = field.mul(r, r)
+    rb = r[None] if not field.limb_shape else r[None]
+    r_x = field.sum(field.mul(x, rb), axis=1)
+    r2_x = field.sum(field.mul(x, r2[None]), axis=1)
+    r_kx = field.sum(field.mul(kx, rb), axis=1)
+    g = lambda i: (rands[:, i] if not field.limb_shape else rands[:, i, :])
+    return SketchOutput(
+        r_x=r_x, r2_x=r2_x, r_kx=r_kx, rand1=g(0), rand2=g(1), rand3=g(2)
+    )
+
+
+@partial(jax.jit, static_argnames=("field",))
+def mul_state(field, out: SketchOutput, mac_key, mac_key2, triples) -> mpc.MulStateBatch:
+    """Assemble the three checks per client (ref: mpc.rs:83-141):
+    (1) r_x*r_x - r2_x; (2) k*k - k²; (3) r_x*k - r_kx."""
+    stack = lambda *vs: jnp.stack(vs, axis=1)
+    xs = stack(out.r_x, mac_key, out.r_x)
+    ys = stack(out.r_x, mac_key, mac_key)
+    zs = stack(field.neg(out.r2_x), field.neg(mac_key2), field.neg(out.r_kx))
+    rs = stack(out.rand1, out.rand2, out.rand3)
+    return mpc.MulStateBatch(xs=xs, ys=ys, zs=zs, rs=rs, triples=triples)
+
+
+def verify_batch(field, state0: mpc.MulStateBatch, state1: mpc.MulStateBatch):
+    """In-process two-server verification: cor exchange + out exchange
+    (the socketpair shape of the dead main.rs:14-72 ``verify_sketches``).
+    Returns bool[N] — True where the client's sketch passes."""
+    c0 = mpc.cor_share(field, state0)
+    c1 = mpc.cor_share(field, state1)
+    opened = mpc.cor(field, c0, c1)
+    o0 = mpc.out_share(field, False, state0, opened)
+    o1 = mpc.out_share(field, True, state1, opened)
+    return np.asarray(mpc.verify(field, o0, o1))
+
+
+# ---------------------------------------------------------------------------
+# Server-side level evaluation over all prefixes of a level (the sketch's
+# own frontier; chunked by sketch_batch_size over the client axis)
+# ---------------------------------------------------------------------------
+
+
+def eval_level_full(key: SketchKeyBatch, level: int, field_t, field_u, data_len: int):
+    """Value-pair shares for ALL 2^(level+1) prefixes at ``level``.
+
+    Walks the DPF tree breadth-first with batched eval (one expansion per
+    level, every (client, prefix) in one program).  Returns
+    field[N, 2^(level+1), LANES(, limbs)]."""
+    k = key.key
+    N = k.root_seed.shape[0]
+    st = jax.tree.map(lambda a: a[:, None], dpf.eval_init(k))  # [N, 1]
+    shares = None
+    for j in range(level + 1):
+        cw = tuple(
+            jax.tree.map(lambda a: a[:, None] if a.ndim > 1 else a, c)
+            for c in dpf.level_cw(k, j)
+        )
+        field = field_t if j < data_len - 1 else field_u
+        cwv = (k.cw_val[:, j] if j < data_len - 1 else k.cw_val_last)[:, None]
+        m = st.t.shape[1]
+        sts, shs = [], []
+        for d in (False, True):
+            dirs = jnp.full((N, m), d)
+            ns, sh = dpf.eval_bit(
+                cw, st, dirs, cwv, k.key_idx[:, None], field, LANES
+            )
+            sts.append(ns)
+            shs.append(sh)
+        st = jax.tree.map(
+            lambda a, b: jnp.stack([a, b], axis=2).reshape((N, 2 * m) + a.shape[2:]),
+            sts[0],
+            sts[1],
+        )
+        shares = jax.tree.map(
+            lambda a, b: jnp.stack([a, b], axis=2).reshape((N, 2 * m) + a.shape[2:]),
+            shs[0],
+            shs[1],
+        )
+    return shares
+
+
+def verify_level(
+    sk0: SketchKeyBatch,
+    sk1: SketchKeyBatch,
+    level: int,
+    field_t,
+    field_u,
+    data_len: int,
+    shared_seed,
+    sketch_batch_size: int = 100_000,
+) -> np.ndarray:
+    """Full two-server sketch verification at one level -> bool[N].
+
+    Chunked over the client axis by ``sketch_batch_size`` (the config knob
+    the reference ships but never parses, src/bin/config.json:9-10)."""
+    last = level == data_len - 1
+    field = field_u if last else field_t
+    N = np.asarray(sk0.key.root_seed).shape[0]
+    m = 1 << (level + 1)
+    out = np.empty(N, bool)
+    for lo in range(0, N, sketch_batch_size):
+        sl = slice(lo, min(lo + sketch_batch_size, N))
+        ks0 = jax.tree.map(lambda a: a[sl], sk0)
+        ks1 = jax.tree.map(lambda a: a[sl], sk1)
+        n_sl = np.asarray(ks0.key.root_seed).shape[0]
+        r, rands = shared_r_stream(field, shared_seed, level, m, n_sl)
+        states = []
+        for ks in (ks0, ks1):
+            pairs = eval_level_full(ks, level, field_t, field_u, data_len)
+            o = sketch_output(field, pairs, r, rands)
+            if last:
+                trip = jax.tree.map(lambda a: a, ks.triples_last)
+                mk, mk2 = ks.mac_key_last, ks.mac_key2_last
+            else:
+                trip = jax.tree.map(lambda a: a[:, level], ks.triples)
+                mk, mk2 = ks.mac_key, ks.mac_key2
+            states.append(mul_state(field, o, mk, mk2, trip))
+        out[sl] = verify_batch(field, states[0], states[1])
+    return out

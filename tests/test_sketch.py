@@ -1,0 +1,214 @@
+"""Malicious-secure sketch + Beaver MPC verification tests.
+
+Covers: payload-DPF one-hot reconstruction, honest sketches passing at
+every level (FE62 inner + F255 last), malformed-key detection for each of
+the three check relations, batch chunking via sketch_batch_size, and the
+end-to-end exclusion of a cheating client from counts through the
+alive_keys gate — over the full two-server RPC protocol at
+sketch_batch_size=100000 (the north-star setting)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from fuzzyheavyhitters_tpu.ops import dpf, ibdcf
+from fuzzyheavyhitters_tpu.ops.fields import F255, FE62
+from fuzzyheavyhitters_tpu.protocol import mpc, rpc, sketch
+from fuzzyheavyhitters_tpu.protocol.leader_rpc import RpcLeader
+from fuzzyheavyhitters_tpu.utils import bits as bitutils
+from fuzzyheavyhitters_tpu.utils.config import Config
+
+import jax
+import jax.numpy as jnp
+
+
+@pytest.fixture(autouse=True)
+def _module_cpu(cpu_default):
+    """Unit-scale module: run on the CPU backend (see conftest)."""
+    yield
+
+
+def _gen(rng, N=6, L=5):
+    alpha = rng.integers(0, 2, size=(N, L)).astype(bool)
+    seeds = rng.integers(0, 2**32, size=(N, 2, 4), dtype=np.uint32)
+    cseed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    shared = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    sk0, sk1 = sketch.gen(seeds, alpha, FE62, F255, cseed)
+    return alpha, sk0, sk1, shared, L
+
+
+def test_dpf_one_hot_reconstruction(rng):
+    """share0 + share1 is the payload at the client's prefix, 0 elsewhere —
+    at every level, both fields (the BGI payload-DPF contract the sketch
+    rides on, ref: sketch.rs:8-24)."""
+    N, L, lanes = 3, 4, 2
+    alpha = rng.integers(0, 2, size=(N, L)).astype(bool)
+    seeds = rng.integers(0, 2**32, size=(N, 2, 4), dtype=np.uint32)
+    vals = jnp.asarray(rng.integers(1, 100, size=(N, L - 1, lanes)).astype(np.uint64))
+    vlast = F255.sample(
+        jnp.asarray(rng.integers(0, 2**32, size=(N, lanes, 8), dtype=np.uint32))
+    )
+    k0, k1 = dpf.gen_pair(seeds, alpha, vals, vlast, FE62, F255, lanes)
+    sk0 = sketch.SketchKeyBatch(
+        k0, None, None, None, None, None, None
+    )
+    sk1 = sketch.SketchKeyBatch(k1, None, None, None, None, None, None)
+    for j in range(L):
+        fld = FE62 if j < L - 1 else F255
+        s0 = sketch.eval_level_full(sk0, j, FE62, F255, L)
+        s1 = sketch.eval_level_full(sk1, j, FE62, F255, L)
+        rec = np.asarray(fld.canon(fld.add(s0, s1)))
+        for i in range(N):
+            idx = int("".join("1" if b else "0" for b in alpha[i, : j + 1]), 2)
+            want = np.zeros_like(rec[i])
+            want[idx] = np.asarray(vals[i, j] if j < L - 1 else vlast[i])
+            np.testing.assert_array_equal(rec[i], want, err_msg=f"lvl {j} client {i}")
+
+
+def test_honest_sketches_pass_all_levels(rng):
+    _, sk0, sk1, shared, L = _gen(rng)
+    for level in range(L):
+        ok = sketch.verify_level(sk0, sk1, level, FE62, F255, L, shared)
+        assert ok.all(), (level, ok)
+
+
+def test_malformed_value_cw_flagged(rng):
+    """A client handing both servers a non-unit payload (additive attack)
+    fails check 1 at exactly the tampered level, only for that client."""
+    _, sk0, sk1, shared, L = _gen(rng)
+    bad = np.asarray(sk0.key.cw_val).copy()
+    bad[2, 1, 0] = (int(bad[2, 1, 0]) + 5) % FE62.P
+    j = jnp.asarray(bad)
+    sk0b = sk0._replace(key=sk0.key._replace(cw_val=j))
+    sk1b = sk1._replace(key=sk1.key._replace(cw_val=j))
+    ok = sketch.verify_level(sk0b, sk1b, 1, FE62, F255, L, shared)
+    assert not ok[2] and ok[[0, 1, 3, 4, 5]].all()
+    assert sketch.verify_level(sk0b, sk1b, 0, FE62, F255, L, shared).all()
+
+
+def test_forged_mac_lane_flagged_last_level(rng):
+    """Forging the k·x lane breaks check 3 in the F255 last level."""
+    _, sk0, sk1, shared, L = _gen(rng)
+    bad = np.asarray(sk0.key.cw_val_last).copy()
+    bad[0, 1, 0] ^= 3
+    j = jnp.asarray(bad)
+    ok = sketch.verify_level(
+        sk0._replace(key=sk0.key._replace(cw_val_last=j)),
+        sk1._replace(key=sk1.key._replace(cw_val_last=j)),
+        L - 1, FE62, F255, L, shared,
+    )
+    assert not ok[0] and ok[1:].all()
+
+
+def test_inconsistent_mac_key_share_flagged(rng):
+    """Tampered k share breaks check 2 (k·k - k² != 0) for every client
+    whose share was touched."""
+    _, sk0, sk1, shared, L = _gen(rng)
+    bad = jnp.asarray(FE62.add(sk0.mac_key, FE62.from_int(1)))
+    ok = sketch.verify_level(
+        sk0._replace(mac_key=bad), sk1, 2, FE62, F255, L, shared
+    )
+    assert not ok.any()
+
+
+def test_sketch_batch_chunking_equivalent(rng):
+    """sketch_batch_size chunking must not change verdicts."""
+    _, sk0, sk1, shared, L = _gen(rng, N=7)
+    a = sketch.verify_level(sk0, sk1, 2, FE62, F255, L, shared,
+                            sketch_batch_size=100_000)
+    b = sketch.verify_level(sk0, sk1, 2, FE62, F255, L, shared,
+                            sketch_batch_size=3)
+    np.testing.assert_array_equal(a, b)
+    assert a.all()
+
+
+def test_triple_verify_catches_bad_product(rng):
+    """Direct MPC layer check: x*y + z == 0 passes, x*y + z != 0 fails."""
+    N = 5
+    seed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    t0, t1 = mpc.gen_triples(FE62, (N, mpc.CHECKS), seed)
+    x = jnp.asarray(rng.integers(0, FE62.P, size=(N, 3)).astype(np.uint64))
+    y = jnp.asarray(rng.integers(0, FE62.P, size=(N, 3)).astype(np.uint64))
+    z_good = FE62.neg(FE62.mul(x, y))
+    r = jnp.asarray(rng.integers(1, FE62.P, size=(N, 3)).astype(np.uint64))
+    zero = FE62.zeros((N, 3))
+
+    def run(z0, z1):
+        s0 = mpc.MulStateBatch(xs=x, ys=zero, zs=z0, rs=r, triples=t0)
+        s1 = mpc.MulStateBatch(xs=zero, ys=y, zs=z1, rs=r, triples=t1)
+        opened = mpc.cor(FE62, mpc.cor_share(FE62, s0), mpc.cor_share(FE62, s1))
+        o0 = mpc.out_share(FE62, False, s0, opened)
+        o1 = mpc.out_share(FE62, True, s1, opened)
+        return np.asarray(mpc.verify(FE62, o0, o1))
+
+    assert run(z_good, zero).all()
+    z_bad = FE62.add(z_good, FE62.from_int(1))
+    assert not run(z_bad, zero).any()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: cheating client excluded from counts through alive_keys,
+# over the full two-server RPC protocol, sketch_batch_size=100000
+# ---------------------------------------------------------------------------
+
+BASE_PORT = 39531
+
+
+def test_malformed_key_excluded_from_counts(rng):
+    L, n = 5, 8
+    # clients 0..5 at point 11, clients 6,7 elsewhere; client 3 cheats
+    pts = np.array([[11]] * 6 + [[25], [2]])
+    pts_bits = np.array(
+        [[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts]
+    )
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 1, rng, engine="np")
+    alpha = pts_bits[:, 0, :]
+    seeds = rng.integers(0, 2**32, size=(n, 2, 4), dtype=np.uint32)
+    cseed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    sk0, sk1 = sketch.gen(seeds, alpha, FE62, F255, cseed)
+    # client 3's payload forged at level 2 (handed identically to both)
+    bad = np.asarray(sk0.key.cw_val).copy()
+    bad[3, 2, 0] = (int(bad[3, 2, 0]) + 1) % FE62.P
+    j = jnp.asarray(bad)
+    sk0 = sk0._replace(key=sk0.key._replace(cw_val=j))
+    sk1 = sk1._replace(key=sk1.key._replace(cw_val=j))
+
+    cfg = Config(
+        data_len=L, n_dims=1, ball_size=1, addkey_batch_size=8, num_sites=4,
+        threshold=0.5, zipf_exponent=1.03,
+        server0=f"127.0.0.1:{BASE_PORT}", server1=f"127.0.0.1:{BASE_PORT + 10}",
+        distribution="zipf", f_max=32, sketch_batch_size=100_000,
+    )
+
+    async def run():
+        s0 = rpc.CollectorServer(0, cfg)
+        s1 = rpc.CollectorServer(1, cfg)
+        t1 = asyncio.create_task(
+            s1.start("127.0.0.1", BASE_PORT + 10, "127.0.0.1", BASE_PORT + 11)
+        )
+        await asyncio.sleep(0.05)
+        t0 = asyncio.create_task(
+            s0.start("127.0.0.1", BASE_PORT, "127.0.0.1", BASE_PORT + 11)
+        )
+        c0 = await rpc.CollectorClient.connect("127.0.0.1", BASE_PORT)
+        c1 = await rpc.CollectorClient.connect("127.0.0.1", BASE_PORT + 10)
+        await asyncio.gather(t0, t1)
+        lead = RpcLeader(cfg, c0, c1)
+        await asyncio.gather(c0.call("reset"), c1.call("reset"))
+        await lead.upload_keys(k0, k1, sk0, sk1)
+        res = await lead.run(n)
+        return res, s0.alive_keys.copy()
+
+    res, alive = asyncio.run(run())
+    # the cheater was excluded exactly
+    np.testing.assert_array_equal(
+        alive, np.array([1, 1, 1, 0, 1, 1, 1, 1], bool)
+    )
+    got = {
+        tuple(int(v) for v in r): int(c)
+        for r, c in zip(res.decode_ints(), res.counts)
+    }
+    # threshold 0.5*8 = 4: the 5 honest clients at 11 clear it; counts
+    # exclude the cheater (5, not 6)
+    assert got == {(10,): 5, (11,): 5, (12,): 5}
