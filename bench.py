@@ -1,11 +1,26 @@
-"""Headline benchmark: ibDCF key generation throughput at data_len=512.
+"""Headline benchmarks on the real chip.
 
-Reference baseline: 99.97 µs/key single-threaded with AES-NI
-(≈10,003 keys/s; src/bin/benchmarks/ibDCFbench.csv:5, BASELINE.md), the
-north-star metric "client-keys/sec/chip at data_len=512".
+Prints ONE JSON line.  Headline metric (continuity with rounds 1-2 and the
+north star "client-keys/sec/chip at data_len=512"): ibDCF keygen
+throughput vs the reference's single-threaded AES-NI baseline
+(99.97 µs/key, src/bin/benchmarks/ibDCFbench.csv:5, BASELINE.md).  The
+``extra`` field carries the rest of the reference's benchmark surface:
 
-Prints ONE JSON line: value = keys/s on one chip, vs_baseline = speedup
-over the reference CPU number.
+- the full keygen sweep data_len ∈ {64, 256, 512, 1024} with per-key wire
+  bytes (the ibDCFbench.rs:55-70 sweep + bincode size column);
+- ``aggregate_clients_per_sec``: the SERVER hot loop — a full
+  data_len=512 trusted-mode crawl (expand -> exchange -> count ->
+  threshold -> prune/advance per level) over N clients on one chip.
+
+HBM plan at N = 1M clients (north star: 1M clients < 10 s on v5e-8): the
+frontier state is ``EvalState[F, N, d, 2]`` = seeds u32[...,4] + 2 bool
+tensors ≈ 18 B per (node, client, dim, side).  At d=1, F=64:
+64·1e6·1·2·18 B ≈ 2.3 GB, and the transient packed-bit tensor is
+F·N·4 B = 256 MB — both fit a single v5e chip's 16 GB HBM.  Key material
+is L·18 B + 16 B per (client, dim, side): at L=512 ≈ 9.2 KB/key·side,
+i.e. ~18.5 GB for 1M clients' full batches — sharded over the 8-chip data
+axis (parallel/mesh.py) that is ~2.3 GB/chip.  No component scales with
+2^d beyond the [F, 2^d] count tensor.
 """
 
 import json
@@ -13,7 +28,114 @@ import time
 
 import numpy as np
 
+BASELINE_US_PER_KEY = {64: None, 128: 25.92, 256: 50.47, 512: 99.97, 1024: 216.25}
 BASELINE_KEYS_PER_SEC = 1e6 / 99.97  # ibDCFbench.csv:5 (data_len=512)
+# reference per-key wire bytes (bincode), ibDCFbench.csv
+BASELINE_KEY_BYTES = {128: 2585, 256: 5145, 512: 10265, 1024: 20505}
+
+
+def _key_wire_bytes(k0) -> int:
+    """Per-key bytes of our wire format (one key = one (client, dim, side)
+    slice of the batch; cf. the reference's bincode size probe,
+    ibDCFbench.rs:67)."""
+    per = 0
+    for leaf in k0:
+        a = np.asarray(leaf)
+        per += a[0].nbytes if a.ndim else a.nbytes
+    return per
+
+
+def bench_keygen(jax, jnp, ibdcf, rng, sweep=(64, 256, 512, 1024), n=8192):
+    rows = {}
+    headline = None
+    for L in sweep:
+        alpha = rng.integers(0, 2, size=(n, L)).astype(bool)
+        seeds = rng.integers(0, 2**32, size=(n, 2, 4), dtype=np.uint32)
+        side = np.ones(n, bool)
+        alpha_d, seeds_d, side_d = map(jax.device_put, (alpha, seeds, side))
+
+        def run():
+            k0, _ = ibdcf.gen_pair(seeds_d, alpha_d, side_d)
+            # reduce on device; fetching the scalar forces completion (the
+            # tunnel's block_until_ready under-reports otherwise)
+            return int(jnp.sum(k0.cw_seed.astype(jnp.uint32))), k0
+
+        _, k0 = run()  # compile + warm
+        iters = 5
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            run()
+        dt = (time.perf_counter() - t0) / iters
+        keys_per_sec = n / dt
+        base = BASELINE_US_PER_KEY.get(L)
+        rows[L] = {
+            "keys_per_sec": round(keys_per_sec, 1),
+            "us_per_key": round(1e6 / keys_per_sec, 3),
+            "key_bytes": _key_wire_bytes(k0),
+            "vs_baseline": round(keys_per_sec / (1e6 / base), 2) if base else None,
+        }
+        if L == 512:
+            headline = keys_per_sec
+    return headline, rows
+
+
+def bench_crawl(ibdcf, driver, rng, n=8192, L=512, f_max=64):
+    """Server hot loop: full L-level trusted-mode crawl on one chip.
+
+    Zipf-like scenario: clients cluster on a handful of sites so the
+    frontier stays small (the production regime) while every level still
+    expands/compares all N clients."""
+    n_sites = 4
+    sites = rng.integers(0, 2, size=(n_sites, 1, L)).astype(bool)
+    pts_bits = sites[rng.integers(0, n_sites, size=n)]
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng, engine="np")
+    s0, s1 = driver.make_servers(k0, k1)
+    lead = driver.Leader(s0, s1, n_dims=1, data_len=L, f_max=f_max)
+    res = lead.run(nreqs=n, threshold=0.05)  # warm + compile (2 programs)
+    assert res.paths.shape[0] >= n_sites  # sites (+ball neighbours) survive
+
+    s0, s1 = driver.make_servers(k0, k1)
+    lead = driver.Leader(s0, s1, n_dims=1, data_len=L, f_max=f_max)
+    t0 = time.perf_counter()
+    res = lead.run(nreqs=n, threshold=0.05)
+    dt = time.perf_counter() - t0
+    return {
+        "aggregate_clients_per_sec": round(n / dt, 1),
+        "crawl_seconds": round(dt, 3),
+        "n_clients": n,
+        "data_len": L,
+        "levels_per_sec": round(L / dt, 2),
+        "hitters": int(res.paths.shape[0]),
+        "projected_1m_clients_seconds_1chip": round(dt * (1_000_000 / n), 1),
+    }
+
+
+def _crawl_subprocess(timeout_s: int = 420):
+    """Run the crawl benchmark in a child process with a hard timeout so a
+    stalled accelerator tunnel can never take down the whole bench run
+    (the keygen headline must always print)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import json, numpy as np, bench;"
+        "from fuzzyheavyhitters_tpu.ops import ibdcf;"
+        "from fuzzyheavyhitters_tpu.protocol import driver;"
+        "print(json.dumps(bench.bench_crawl(ibdcf, driver,"
+        " np.random.default_rng(0))))"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            timeout=timeout_s,
+            text=True,
+            cwd=__file__.rsplit("/", 1)[0],
+        )
+        line = out.stdout.strip().splitlines()[-1]
+        return json.loads(line)
+    except Exception as e:  # timeout, crash, parse failure
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
 
 
 def main():
@@ -23,33 +145,21 @@ def main():
     from fuzzyheavyhitters_tpu.ops import ibdcf
 
     rng = np.random.default_rng(0)
-    n, L = 8192, 512
-    alpha = rng.integers(0, 2, size=(n, L)).astype(bool)
-    seeds = rng.integers(0, 2**32, size=(n, 2, 4), dtype=np.uint32)
-    side = np.ones(n, bool)
-    alpha, seeds, side = map(jax.device_put, (alpha, seeds, side))
-
-    def run():
-        k0, _ = ibdcf.gen_pair(seeds, alpha, side)
-        # reduce on device; fetching the scalar forces completion (the
-        # tunnel's block_until_ready under-reports otherwise)
-        return int(jnp.sum(k0.cw_seed.astype(jnp.uint32)))
-
-    run()  # compile + warm
-    iters = 5
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        run()
-    dt = (time.perf_counter() - t0) / iters
-    keys_per_sec = n / dt
+    headline, sweep = bench_keygen(jax, jnp, ibdcf, rng)
+    crawl = _crawl_subprocess()
 
     print(
         json.dumps(
             {
                 "metric": "ibdcf_keygen_keys_per_sec_at_data_len_512",
-                "value": round(keys_per_sec, 1),
+                "value": round(headline, 1),
                 "unit": "keys/s/chip",
-                "vs_baseline": round(keys_per_sec / BASELINE_KEYS_PER_SEC, 2),
+                "vs_baseline": round(headline / BASELINE_KEYS_PER_SEC, 2),
+                "extra": {
+                    "keygen_sweep": sweep,
+                    "reference_key_bytes": BASELINE_KEY_BYTES,
+                    "crawl": crawl,
+                },
             }
         )
     )

@@ -9,12 +9,15 @@ on a mesh axis, and the server<->server exchange is an XLA collective.
 Layout (mirrors the reference's layer map, SURVEY.md §1):
 
 - ``utils``     bit codecs, bitstring arithmetic, config    (ref: src/lib.rs, src/config.rs)
-- ``ops``       PRG, prime fields, ibDCF keygen/eval, 2PC   (ref: src/prg.rs, src/fastfield.rs,
+- ``ops``       PRG, prime fields, ibDCF + payload DPF,
+                GC/base-OT/OT-extension primitives           (ref: src/prg.rs, src/fastfield.rs,
                                                              src/field.rs, src/ibDCF.rs,
                                                              src/equalitytest.rs)
 - ``parallel``  device mesh + server/client-axis collectives (ref: src/bin/server.rs TCP mesh)
-- ``models``    the aggregation engine / protocol state machine (ref: src/collect.rs)
-- ``protocol``  leader/server processes + 8-verb RPC         (ref: src/rpc.rs, src/bin/*.rs)
+- ``protocol``  aggregation engine, secure data plane,
+                sketch/MPC verification, leader/server RPC   (ref: src/collect.rs, src/rpc.rs,
+                                                             src/sketch.rs, src/mpc.rs,
+                                                             src/bin/*.rs)
 - ``workloads`` zipf / rides / covid samplers + CSV output   (ref: src/sample_*.rs)
 
 64-bit integer support is required for the fast 62-bit field (``ops.field62``);

@@ -86,10 +86,7 @@ async def amain() -> None:
 
     print(f"{cfg.distribution} distribution sampling...")
     pts = sample_points(cfg, nreqs, rng)
-    if cfg.distribution == "rides":
-        k0, k1 = ibdcf.gen_l_inf_ball(pts, cfg.ball_size, rng)
-    else:
-        k0, k1 = ibdcf.gen_l_inf_ball(pts, cfg.ball_size, rng)
+    k0, k1 = ibdcf.gen_l_inf_ball(pts, cfg.ball_size, rng)
 
     h0, p0 = _split(cfg.server0)
     h1, p1 = _split(cfg.server1)

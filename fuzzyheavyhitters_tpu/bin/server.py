@@ -24,8 +24,13 @@ def _split(addr: str) -> tuple[str, int]:
 
 
 async def amain() -> None:
+    import contextlib
+
+    import jax
+
     cfg, server_id, _ = configmod.get_args("Server", get_server_id=True)
-    assert server_id in (0, 1), f"server_id must be 0 or 1, got {server_id}"
+    if server_id not in (0, 1):
+        raise SystemExit(f"server_id must be 0 or 1, got {server_id}")
     host0, port0 = _split(cfg.server0)
     host1, port1 = _split(cfg.server1)
     my_host, my_port = (host0, port0) if server_id == 0 else (host1, port1)
@@ -33,11 +38,20 @@ async def amain() -> None:
     peer_host = host1 if server_id == 0 else my_host
     peer_port = port1 + 1
 
-    server = CollectorServer(server_id, cfg)
-    srv = await server.start(my_host, my_port, peer_host, peer_port)
-    print(f"server {server_id} serving on {my_host}:{my_port}", flush=True)
-    async with srv:
-        await srv.serve_forever()
+    # cfg.backend selects the aggregation device: "cpu" pins every
+    # uncommitted array op onto the host backend (useful where no
+    # accelerator is attached); "tpu" (default) keeps JAX's default device.
+    ctx = (
+        jax.default_device(jax.devices("cpu")[0])
+        if cfg.backend == "cpu"
+        else contextlib.nullcontext()
+    )
+    with ctx:
+        server = CollectorServer(server_id, cfg)
+        srv = await server.start(my_host, my_port, peer_host, peer_port)
+        print(f"server {server_id} serving on {my_host}:{my_port}", flush=True)
+        async with srv:
+            await srv.serve_forever()
 
 
 def main() -> None:

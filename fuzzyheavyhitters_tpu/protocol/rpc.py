@@ -37,6 +37,7 @@ import asyncio
 import pickle
 import secrets as _secrets
 import struct
+import time
 from dataclasses import dataclass, field
 
 import jax
@@ -283,20 +284,32 @@ class CollectorServer:
         return peer
 
     async def _crawl_counts(self, level: int) -> np.ndarray:
+        t0 = time.perf_counter()
         packed = collect.expand_share_bits(self.keys, self.frontier, level)
+        packed_np = np.asarray(packed)  # forces the device work to finish
+        t1 = time.perf_counter()
         # data plane: swap packed share bits with the peer server
-        peer = await self._swap(np.asarray(packed))
+        peer = await self._swap(packed_np)
+        t2 = time.perf_counter()
         masks = collect.pattern_masks(self.keys.cw_seed.shape[1])
         counts = collect.counts_by_pattern(
             packed, peer, masks, self.alive_keys, self.frontier.alive
         )
-        return np.asarray(counts)
+        counts = np.asarray(counts)
+        t3 = time.perf_counter()
+        # per-level phase taxonomy of the reference (collect.rs:412-503);
+        # trusted mode's "GC and OT" slot is the plaintext exchange
+        print(f"Tree searching and FSS - {t1 - t0:.4f}s")
+        print(f"Garbled Circuit and OT - {t2 - t1:.4f}s")
+        print(f"Field actions - {t3 - t2:.4f}s")
+        return counts
 
     async def _crawl_counts_secure(self, level: int, count_field) -> np.ndarray:
         """The real 2PC data plane (ref: collect.rs:419-501): GC equality +
         OT b2a over the peer socket; returns this server's additive field
         share of every per-(node, pattern) count.  No packed share-bit
         tensor ever crosses the server boundary in this mode."""
+        t0 = time.perf_counter()
         packed = collect.expand_share_bits(self.keys, self.frontier, level)
         d = self.keys.cw_seed.shape[1]
         C, S = 1 << d, 2 * d
@@ -304,6 +317,8 @@ class CollectorServer:
         F_, _, N, _ = strs.shape
         B = F_ * C * N
         flat = strs.reshape(B, S)
+        jax.block_until_ready(flat)
+        t1 = time.perf_counter()
         w = secure.alive_weight(self.frontier.alive, self.alive_keys, C)
         # crawl counter makes every garbling's randomness unique even if a
         # leader re-crawls a level without reset (seed reuse with a fixed
@@ -328,9 +343,16 @@ class CollectorServer:
             await _send(self._peer_writer, np.asarray(u2))
             c0, c1 = await _recv(self._peer_reader)
             vals = secure.ev_step4(self._ot, t2_rows, idx0, c0, c1, e, count_field)
+        jax.block_until_ready(vals)
+        t2 = time.perf_counter()
         vals = vals.reshape((F_, C, N) + count_field.limb_shape)
         shares = secure.node_share_sums(count_field, vals, jnp.asarray(w))
-        return np.asarray(shares)
+        shares = np.asarray(shares)
+        t3 = time.perf_counter()
+        print(f"Tree searching and FSS - {t1 - t0:.4f}s")
+        print(f"Garbled Circuit and OT - {t2 - t1:.4f}s")
+        print(f"Field actions - {t3 - t2:.4f}s")
+        return shares
 
     async def tree_crawl(self, req) -> np.ndarray:
         """-> FE62 shares of per-child counts [F, 2^d] (ref: rpc.rs:60)."""

@@ -3,16 +3,20 @@
 The schema mirrors ``Config`` in the reference (ref: src/config.rs:5-16):
 ``data_len, n_dims, ball_size, addkey_batch_size, num_sites, threshold,
 zipf_exponent, server0, server1, distribution``.  The reference's shipped
-JSON files also carry ``sketch_batch_size`` / ``sketch_batch_size_last`` keys
-that its parser ignores (config.rs vs src/bin/config.json:9-10); we parse them
-(the resurrected malicious-secure sketch uses them) with the shipped defaults.
+JSON files also carry ``sketch_batch_size`` / ``sketch_batch_size_last``
+keys that its parser ignores (config.rs vs src/bin/config.json:9-10); here
+they are live: protocol/rpc.py's ``sketch_verify`` chunks the client axis
+by them (the *_last knob covers the 8-limb F255 level).
 
 Extra TPU-native knobs (all defaulted so reference configs load unchanged):
 
-- ``backend``: "tpu" | "cpu" — device for server-side aggregation.
-- ``secure_exchange``: if True, use the GC+OT 2PC data plane; if False, the
-  trusted-exchange mode that reveals per-(node,client) equality bits between
-  the two servers (counts are still additively shared toward the leader).
+- ``backend``: "tpu" | "cpu" — aggregation device; "cpu" pins the server's
+  array ops onto the host backend (bin/server.py).
+- ``secure_exchange``: if True, the GC+OT 2PC data plane (protocol/secure.py);
+  if False, the trusted-exchange mode that reveals per-(node,client)
+  equality bits between the two servers (counts still travel as field
+  shares toward the leader).
+- ``f_max``: padded-frontier capacity (static device shapes).
 """
 
 from __future__ import annotations

@@ -24,7 +24,8 @@ def load_centroids(path: str) -> dict[str, tuple[float, float]]:
     """FIPS -> (lat, lon) from county_centroids.csv
     (ref: sample_covid_data.rs:17-30)."""
     out = {}
-    with open(path, newline="") as f:
+    # utf-8-sig: the shipped centroid CSV begins with a UTF-8 BOM
+    with open(path, newline="", encoding="utf-8-sig") as f:
         for row in csv.DictReader(f):
             out[row["fips_code"]] = (float(row["latitude"]), float(row["longitude"]))
     return out

@@ -1,6 +1,7 @@
 """Workload-layer tests (host-only: samplers, codecs, writers)."""
 
 import csv
+import os
 import numpy as np
 import pytest
 
@@ -132,3 +133,39 @@ def test_covid_sampler_with_case_csv(centroids_csv, tmp_path):
 def test_f64_bits_roundtrip():
     for v in (0.0, -97.74, 30.26, 1e-12, float(np.pi)):
         assert covid.bool_vec_to_f64(covid.f64_to_bool_vec(v)) == v
+
+
+def test_visualization_scripts_render(tmp_path):
+    """Both visualization counterparts render PNGs without the 9 GB raw
+    inputs (ref: src/*_visualization.py; ours read the sampler fallbacks
+    and the protocol's heavy-hitter output CSV)."""
+    pytest.importorskip("matplotlib")
+    from fuzzyheavyhitters_tpu.workloads import (
+        covid_data_visualization as cviz,
+        ride_austin_visualization as rviz,
+        rides,
+    )
+
+    # synthesize a heavy-hitter CSV like the leader writes
+    paths = np.zeros((3, 2, 16), bool)
+    paths[:, :, 0] = True  # positive offset-binary coords
+    hit_csv = tmp_path / "hh.csv"
+    rides.save_heavy_hitters(paths, str(hit_csv))
+
+    out = rviz.visualize(
+        hitters_path=str(hit_csv),
+        raw_path=str(tmp_path / "missing.csv"),  # forces synthetic fallback
+        n=500,
+        out_dir=str(tmp_path / "ride_plots"),
+    )
+    assert len(out) == 3 and all(os.path.getsize(p) > 1000 for p in out)
+
+    out = cviz.visualize(
+        centroids_path=os.path.join(
+            os.path.dirname(__file__), "..", "data", "county_centroids.csv"
+        ),
+        cases_path=str(tmp_path / "missing.csv"),
+        n=500,
+        out_dir=str(tmp_path / "covid_plots"),
+    )
+    assert len(out) == 3 and all(os.path.getsize(p) > 1000 for p in out)
