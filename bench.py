@@ -110,6 +110,63 @@ def bench_crawl(ibdcf, driver, rng, n=8192, L=512, f_max=64):
     }
 
 
+def bench_upload(n=100_000, L=16, batch=1000, port=39731):
+    """100k-key ingest benchmark: leader -> two servers over localhost TCP
+    with the id'd pipelined framing (ref: leader.rs:340-364's 1000
+    in-flight batches).  Host-side only — add_keys appends buffers; the
+    device sees keys once at tree_init."""
+    import asyncio
+
+    from fuzzyheavyhitters_tpu.ops import ibdcf
+    from fuzzyheavyhitters_tpu.protocol import rpc
+    from fuzzyheavyhitters_tpu.protocol.leader_rpc import RpcLeader
+    from fuzzyheavyhitters_tpu.utils.config import Config
+
+    rng = np.random.default_rng(1)
+    alpha = rng.integers(0, 2, size=(n, 1, 2, L)).astype(bool)
+    seeds = rng.integers(0, 2**32, size=(n, 1, 2, 2, 4), dtype=np.uint32)
+    side = np.broadcast_to(np.array([True, False]), (n, 1, 2))
+    k0, k1 = ibdcf.gen_pair_np(seeds, alpha, side)
+
+    cfg = Config(
+        data_len=L, n_dims=1, ball_size=1, addkey_batch_size=batch,
+        num_sites=4, threshold=0.1, zipf_exponent=1.03,
+        server0=f"127.0.0.1:{port}", server1=f"127.0.0.1:{port + 10}",
+        distribution="zipf", f_max=32,
+    )
+
+    async def run():
+        s0 = rpc.CollectorServer(0, cfg)
+        s1 = rpc.CollectorServer(1, cfg)
+        t1 = asyncio.create_task(
+            s1.start("127.0.0.1", port + 10, "127.0.0.1", port + 11)
+        )
+        await asyncio.sleep(0.05)
+        t0 = asyncio.create_task(
+            s0.start("127.0.0.1", port, "127.0.0.1", port + 11)
+        )
+        c0 = await rpc.CollectorClient.connect("127.0.0.1", port)
+        c1 = await rpc.CollectorClient.connect("127.0.0.1", port + 10)
+        await asyncio.gather(t0, t1)
+        lead = RpcLeader(cfg, c0, c1)
+        await asyncio.gather(c0.call("reset"), c1.call("reset"))
+        t = time.perf_counter()
+        await lead.upload_keys(k0, k1)
+        return time.perf_counter() - t
+
+    dt = asyncio.run(run())
+    # _key_wire_bytes slices only the client axis, so for these [n, 1, 2]
+    # interval batches it already covers both sides = one server's payload
+    per_key_bytes = _key_wire_bytes(k0)
+    return {
+        "upload_keys_per_sec": round(n / dt, 1),
+        "upload_seconds": round(dt, 3),
+        "n_keys": n,
+        "addkey_batch_size": batch,
+        "approx_mb_per_sec": round(n * per_key_bytes / dt / 1e6, 1),
+    }
+
+
 def _crawl_subprocess(timeout_s: int = 420):
     """Run the crawl benchmark in a child process with a hard timeout so a
     stalled accelerator tunnel can never take down the whole bench run
@@ -147,6 +204,10 @@ def main():
     rng = np.random.default_rng(0)
     headline, sweep = bench_keygen(jax, jnp, ibdcf, rng)
     crawl = _crawl_subprocess()
+    try:
+        upload = bench_upload()
+    except Exception as e:
+        upload = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     print(
         json.dumps(
@@ -159,6 +220,7 @@ def main():
                     "keygen_sweep": sweep,
                     "reference_key_bytes": BASELINE_KEY_BYTES,
                     "crawl": crawl,
+                    "upload": upload,
                 },
             }
         )

@@ -67,7 +67,10 @@ class RpcLeader:
                 "add_keys",
                 {"keys": _key_chunk(keys1, sl), "sketch": sk_chunk(sketch1, sl)},
             ))
-            if len(pending) >= 16:  # bounded in-flight window
+            # bounded in-flight window; the id'd framing pipelines all of
+            # these on the two connections (ref: 1000 in flight,
+            # leader.rs:342)
+            if len(pending) >= 128:
                 await asyncio.gather(*pending)
                 pending = []
         if pending:

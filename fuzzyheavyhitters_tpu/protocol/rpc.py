@@ -124,6 +124,7 @@ class CollectorServer:
     _sketch_pairs: tuple | None = None  # (pair shares [F, N, lanes], depth)
     _sketch_pairs_field: object | None = None
     _sketch_seed: np.ndarray | None = None  # coin-flipped challenge seed
+    _verb_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
 
     # -- verbs (ref: rpc.rs:56-66) ---------------------------------------
 
@@ -445,15 +446,42 @@ class CollectorServer:
     )
 
     async def _handle_leader(self, reader, writer):
+        """Control-plane serve loop with request ids and concurrent
+        handling (the reference's tarpc ids + buffer_unordered(100),
+        server.rs:359-376): each frame is (req_id, verb, payload) and every
+        request runs as its own task, so many in-flight add_keys batches
+        deserialize and append while others are still on the wire.  Verbs
+        that touch the data plane or mutate protocol state serialize on
+        ``_verb_lock``; responses carry the id so completion order is
+        free."""
+        write_lock = asyncio.Lock()
+
+        async def handle(req_id, verb, req):
+            try:
+                if verb == "add_keys":  # append-only; no awaits -> atomic
+                    resp = await self.add_keys(req)
+                else:
+                    async with self._verb_lock:
+                        resp = await getattr(self, verb)(req)
+            except Exception as e:  # surface to the caller, don't hang it
+                resp = {"__error__": f"{type(e).__name__}: {e}"}
+            async with write_lock:
+                await _send(writer, (req_id, resp))
+
+        tasks = set()
         try:
             while True:
-                verb, req = await _recv(reader)
-                assert verb in self._VERBS, verb
-                resp = await getattr(self, verb)(req)
-                await _send(writer, resp)
+                req_id, verb, req = await _recv(reader)
+                if verb not in self._VERBS:
+                    raise ValueError(f"unknown verb {verb!r}")
+                t = asyncio.create_task(handle(req_id, verb, req))
+                tasks.add(t)
+                t.add_done_callback(tasks.discard)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
+            for t in tasks:
+                t.cancel()
             writer.close()
 
     async def start(self, host: str, port: int, peer_host: str, peer_port: int):
@@ -529,14 +557,19 @@ class CollectorServer:
 
 
 class CollectorClient:
-    """Leader-side RPC stub (the tarpc-generated client analogue)."""
+    """Leader-side RPC stub (the tarpc-generated client analogue).
+
+    The framing carries request ids, so any number of calls may be in
+    flight on one connection; a reader task resolves futures by id
+    (tarpc's pipelining model, leader.rs:340-364 drives 1000 in-flight
+    addkey batches through it)."""
 
     def __init__(self, reader, writer):
         self._r, self._w = reader, writer
-        # one in-flight request per connection: the framing carries no
-        # request ids (unlike tarpc), so send+recv must be atomic.  Callers
-        # get pipelining by opening more connections, not by interleaving.
-        self._lock = asyncio.Lock()
+        self._send_lock = asyncio.Lock()
+        self._pending: dict[int, asyncio.Future] = {}
+        self._next_id = 0
+        self._reader_task = asyncio.ensure_future(self._read_loop())
 
     @classmethod
     async def connect(cls, host: str, port: int, retries: int = 40):
@@ -548,10 +581,33 @@ class CollectorClient:
                 await asyncio.sleep(0.25)
         raise ConnectionError(f"server {host}:{port} unreachable")
 
+    async def _read_loop(self):
+        try:
+            while True:
+                req_id, resp = await _recv(self._r)
+                fut = self._pending.pop(req_id, None)
+                if fut is not None and not fut.done():
+                    fut.set_result(resp)
+        except Exception as e:  # any reader death fails every caller loudly
+            self._dead = ConnectionError(f"connection lost: {e!r}")
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError(f"connection lost: {e!r}"))
+            self._pending.clear()
+
     async def call(self, verb: str, req=None):
-        async with self._lock:
-            await _send(self._w, (verb, req or {}))
-            return await _recv(self._r)
+        if getattr(self, "_dead", None) is not None:
+            raise self._dead
+        self._next_id += 1
+        req_id = self._next_id
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[req_id] = fut
+        async with self._send_lock:
+            await _send(self._w, (req_id, verb, req or {}))
+        resp = await fut
+        if isinstance(resp, dict) and "__error__" in resp:
+            raise RuntimeError(f"server error on {verb}: {resp['__error__']}")
+        return resp
 
     def __getattr__(self, verb):
         if verb.startswith("_"):
