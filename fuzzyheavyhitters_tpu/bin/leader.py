@@ -88,6 +88,20 @@ async def amain() -> None:
     pts = sample_points(cfg, nreqs, rng)
     k0, k1 = ibdcf.gen_l_inf_ball(pts, cfg.ball_size, rng)
 
+    sk0 = sk1 = None
+    if cfg.malicious:
+        # malicious-security material: MAC'd payload DPFs over the client's
+        # point + Beaver triples (protocol/sketch.py; ref north star names
+        # the resurrected sketch.rs path)
+        if cfg.n_dims != 1:
+            raise SystemExit("malicious mode requires n_dims == 1 (one-hot sketch)")
+        from ..ops.fields import F255, FE62
+        from ..protocol import sketch as sketchmod
+
+        seeds = rng.integers(0, 2**32, size=(nreqs, 2, 4), dtype=np.uint32)
+        cseed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+        sk0, sk1 = sketchmod.gen(seeds, pts[:, 0, :], FE62, F255, cseed)
+
     h0, p0 = _split(cfg.server0)
     h1, p1 = _split(cfg.server1)
     c0 = await CollectorClient.connect(h0, p0)
@@ -96,7 +110,7 @@ async def amain() -> None:
 
     lead = RpcLeader(cfg, c0, c1)
     t0 = time.perf_counter()
-    await lead.upload_keys(k0, k1)
+    await lead.upload_keys(k0, k1, sk0, sk1)
     print(f"AddKeysDone in {time.perf_counter() - t0:.2f}s")
 
     t0 = time.perf_counter()

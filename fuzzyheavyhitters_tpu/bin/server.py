@@ -40,7 +40,14 @@ async def amain() -> None:
 
     # cfg.backend selects the aggregation device: "cpu" pins every
     # uncommitted array op onto the host backend (useful where no
-    # accelerator is attached); "tpu" (default) keeps JAX's default device.
+    # accelerator is attached); "tpu" (default) keeps JAX's default device
+    # and, when an accelerator actually resolved (not an XLA:CPU fallback,
+    # where unrolled rounds only inflate compiles), switches the PRG to its
+    # unrolled round loop (faster chip execution — ops/prg.py).
+    if cfg.backend != "cpu" and jax.default_backend() != "cpu":
+        from ..ops import prg
+
+        prg.CHACHA_UNROLL = True
     ctx = (
         jax.default_device(jax.devices("cpu")[0])
         if cfg.backend == "cpu"

@@ -59,24 +59,28 @@ _TWEAK2 = 0x9E3779B9
 _TWEAK3 = 0x7F4A7C15
 
 
-def _hash(label: jax.Array, gate_ids: jax.Array, half: int) -> jax.Array:
-    """Correlation-robust hash H(label, tweak) -> uint32[..., 4].
+def _hash_many(labels: jax.Array, gate_ids: jax.Array, halves) -> jax.Array:
+    """Correlation-robust hash H(label, tweak) over m stacked label sets.
 
-    tweak = (gate id, half-gate selector, const, const) XORed into the
-    label block before the fixed-key ChaCha permutation; the feed-forward
-    add makes the map non-invertible (the Davies-Meyer role, as in
-    fixed-key-AES garbling).
+    labels: uint32[m, ..., 4]; halves: length-m ints (per-set half-gate
+    selector).  tweak = (gate id, half selector, const, const) XORed into
+    each label block before the fixed-key ChaCha permutation; the
+    feed-forward add makes the map non-invertible (the Davies-Meyer role,
+    as in fixed-key-AES garbling).  One stacked call per gate layer keeps
+    the ChaCha op count — the dominant XLA compile cost of GC programs —
+    at one block-function instance per layer instead of m.
     """
+    labels = jnp.asarray(labels, jnp.uint32)
+    g = jnp.asarray(gate_ids, jnp.uint32)  # [k], right-aligned broadcast
     tweak = jnp.stack(
-        [
-            jnp.asarray(gate_ids, jnp.uint32),
-            jnp.full_like(jnp.asarray(gate_ids, jnp.uint32), half),
-            jnp.full_like(jnp.asarray(gate_ids, jnp.uint32), _TWEAK2),
-            jnp.full_like(jnp.asarray(gate_ids, jnp.uint32), _TWEAK3),
-        ],
+        [g, jnp.zeros_like(g), jnp.full_like(g, _TWEAK2), jnp.full_like(g, _TWEAK3)],
         axis=-1,
-    )
-    return prg.chacha_block(label ^ tweak)[..., :4]
+    )  # [k, 4]
+    m = labels.shape[0]
+    h = jnp.asarray(halves, jnp.uint32).reshape((m,) + (1,) * (labels.ndim - 2))
+    x = labels ^ tweak
+    x = x.at[..., 1].set(x[..., 1] ^ h)  # half selector = tweak word 1
+    return prg.chacha_block(x)[..., :4]
 
 
 def _maskw(bit: jax.Array, block: jax.Array) -> jax.Array:
@@ -121,12 +125,12 @@ def _and_tree_garble(wires0, R):
         B0 = wires0[..., 1 : 2 * k : 2, :]
         gids = jnp.arange(gate, gate + k, dtype=jnp.uint32)
         pa, pb = _lsb(A0), _lsb(B0)
-        HA0 = _hash(A0, gids, 0)
-        HA1 = _hash(A0 ^ R[..., None, :], gids, 0)
-        TG = HA0 ^ HA1 ^ _maskw(pb, R[..., None, :])
+        Rb = R[..., None, :]
+        HA0, HA1, HB0, HB1 = _hash_many(
+            jnp.stack([A0, A0 ^ Rb, B0, B0 ^ Rb]), gids, (0, 0, 1, 1)
+        )
+        TG = HA0 ^ HA1 ^ _maskw(pb, Rb)
         WG = HA0 ^ _maskw(pa, TG)
-        HB0 = _hash(B0, gids, 1)
-        HB1 = _hash(B0 ^ R[..., None, :], gids, 1)
         TE = HB0 ^ HB1 ^ A0
         WE = HB0 ^ _maskw(pb, TE ^ A0)
         C0 = WG ^ WE
@@ -148,8 +152,9 @@ def _and_tree_eval(wires, tables):
         gids = jnp.arange(gate, gate + k, dtype=jnp.uint32)
         TG = tables[..., gate : gate + k, 0, :]
         TE = tables[..., gate : gate + k, 1, :]
-        WG = _hash(A, gids, 0) ^ _maskw(_lsb(A), TG)
-        WE = _hash(B, gids, 1) ^ _maskw(_lsb(B), TE ^ A)
+        HA, HB = _hash_many(jnp.stack([A, B]), gids, (0, 1))
+        WG = HA ^ _maskw(_lsb(A), TG)
+        WE = HB ^ _maskw(_lsb(B), TE ^ A)
         C = WG ^ WE
         gate += k
         wires = jnp.concatenate([C, wires[..., 2 * k :, :]], axis=-2)

@@ -41,6 +41,14 @@ import numpy as np
 SEED_WORDS = 4  # 128-bit seeds as uint32[..., 4], little-endian word order
 N_ROUNDS = 8  # ChaCha double-round count = N_ROUNDS // 2
 
+# Round-loop form, read at TRACE time (set before the first jit call in the
+# process; bin/server.py and bench.py set it for the TPU backend):
+#   False -> lax.scan over double-rounds: ~4x smaller HLO per call site, the
+#            right default on compile-bound hosts (XLA:CPU on small cores);
+#   True  -> unrolled rounds: ~6% faster keygen on the TPU chip.
+# Both forms compute identical bits (same math, one loop rolled).
+CHACHA_UNROLL = False
+
 # "expand 32-byte k" — the standard ChaCha constant words.
 _SIGMA = (0x61707865, 0x3320646E, 0x79622D32, 0x6B206574)
 # Fixed 256-bit key, public by construction (nothing-up-my-sleeve: the
@@ -76,26 +84,58 @@ def chacha_block(block: jax.Array) -> jax.Array:
     State = 4 constant words | 8 fixed-key words | the 4 input-block words,
     permuted N_ROUNDS rounds, with the standard feed-forward addition (which
     makes the map non-invertible — the Davies-Meyer role of prg.rs:120).
+
+    Column-vectorized (SIMD ChaCha): the 4x4 state's rows live in one
+    ``uint32[4, 4, ...]`` tensor; a column round is ONE quarter-round whose
+    ops each span all 4 columns, and the diagonal round is the same after
+    rolling row k by k — so a block call is ~8 wide quarter-rounds of HLO
+    instead of 32 scalar-lane ones.  This quarters both the compile-time
+    footprint of every kernel that embeds the PRG (the whole suite is
+    compile-bound on 1-core XLA:CPU hosts) and the op-dispatch count at
+    runtime.  The math (and thus every output bit) is unchanged.
     """
     block = jnp.asarray(block, jnp.uint32)
     if block.shape[-1] != SEED_WORDS:
         raise ValueError(f"input blocks must be uint32[..., 4], got {block.shape}")
     shape = block.shape[:-1]
-    x = [jnp.broadcast_to(jnp.uint32(w), shape) for w in _SIGMA + _FIXED_KEY]
-    x += [block[..., i] for i in range(4)]
-    init = list(x)
-    for _ in range(N_ROUNDS // 2):
-        # column round
-        x[0], x[4], x[8], x[12] = _quarter_round(x[0], x[4], x[8], x[12])
-        x[1], x[5], x[9], x[13] = _quarter_round(x[1], x[5], x[9], x[13])
-        x[2], x[6], x[10], x[14] = _quarter_round(x[2], x[6], x[10], x[14])
-        x[3], x[7], x[11], x[15] = _quarter_round(x[3], x[7], x[11], x[15])
-        # diagonal round
-        x[0], x[5], x[10], x[15] = _quarter_round(x[0], x[5], x[10], x[15])
-        x[1], x[6], x[11], x[12] = _quarter_round(x[1], x[6], x[11], x[12])
-        x[2], x[7], x[8], x[13] = _quarter_round(x[2], x[7], x[8], x[13])
-        x[3], x[4], x[9], x[14] = _quarter_round(x[3], x[4], x[9], x[14])
-    out = jnp.stack([a + b for a, b in zip(x, init)], axis=-1)
+    const = jnp.asarray(_SIGMA + _FIXED_KEY, jnp.uint32)
+    # XOR with a zero derived from the input: a no-op numerically, but it
+    # makes the constant rows data-dependent on `block`, so under shard_map
+    # they carry the same varying-axes annotation as the input and the
+    # round scan's carry types line up (scan-vma rule).
+    rows = jnp.broadcast_to(const, shape + (12,)) ^ (block[..., :1] & jnp.uint32(0))
+    # state rows: [..., 4 cols] each; row r holds words 4r..4r+3
+    a = rows[..., 0:4]
+    b = rows[..., 4:8]
+    c = rows[..., 8:12]
+    d = block
+    init = (a, b, c, d)
+
+    def _double_round(state, _):
+        a, b, c, d = state
+        # column round: one QR across all 4 columns at once
+        a, b, c, d = _quarter_round(a, b, c, d)
+        # diagonalize: row k rolls left by k -> diagonal round is a column
+        # round on the rolled rows (standard SIMD ChaCha row rotation)
+        b = jnp.roll(b, -1, axis=-1)
+        c = jnp.roll(c, -2, axis=-1)
+        d = jnp.roll(d, -3, axis=-1)
+        a, b, c, d = _quarter_round(a, b, c, d)
+        b = jnp.roll(b, 1, axis=-1)
+        c = jnp.roll(c, 2, axis=-1)
+        d = jnp.roll(d, 3, axis=-1)
+        return (a, b, c, d), None
+
+    if CHACHA_UNROLL:
+        for _ in range(N_ROUNDS // 2):
+            (a, b, c, d), _ = _double_round((a, b, c, d), None)
+    else:
+        (a, b, c, d), _ = jax.lax.scan(
+            _double_round, (a, b, c, d), None, length=N_ROUNDS // 2
+        )
+    out = jnp.concatenate(
+        [x + y for x, y in zip((a, b, c, d), init)], axis=-1
+    )
     # Fusion fence: without it, XLA:CPU's loop-fusion emitter re-evaluates
     # the entire ~400-op ChaCha DAG once per consumer output element when a
     # consumer slices this block (e.g. out[..., 0:4]), which turns kernels

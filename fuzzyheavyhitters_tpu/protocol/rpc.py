@@ -465,8 +465,11 @@ class CollectorServer:
                         resp = await getattr(self, verb)(req)
             except Exception as e:  # surface to the caller, don't hang it
                 resp = {"__error__": f"{type(e).__name__}: {e}"}
-            async with write_lock:
-                await _send(writer, (req_id, resp))
+            try:
+                async with write_lock:
+                    await _send(writer, (req_id, resp))
+            except (ConnectionResetError, BrokenPipeError, RuntimeError):
+                pass  # leader gone; the work itself must still have finished
 
         tasks = set()
         try:
@@ -480,8 +483,13 @@ class CollectorServer:
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
-            for t in tasks:
-                t.cancel()
+            # Drain, never cancel: a verb may be mid-_swap on the PERSISTENT
+            # peer data plane — cancelling between its send and recv would
+            # leave the peer's frame unread and desynchronize every later
+            # exchange (the old sequential loop always finished the verb in
+            # flight; concurrent handling must keep that guarantee).
+            if tasks:
+                await asyncio.gather(*tasks, return_exceptions=True)
             writer.close()
 
     async def start(self, host: str, port: int, peer_host: str, peer_port: int):

@@ -207,12 +207,25 @@ def verify_batch(field, state0: mpc.MulStateBatch, state1: mpc.MulStateBatch):
 def eval_level_full(key: SketchKeyBatch, level: int, field_t, field_u, data_len: int):
     """Value-pair shares for ALL 2^(level+1) prefixes at ``level``.
 
-    Walks the DPF tree breadth-first with batched eval (one expansion per
-    level, every (client, prefix) in one program).  Returns
+    Walks a fixed ``2^data_len``-slot padded tree: slot i's direction at
+    step j is bit ``data_len-1-j`` of i, so slots sharing a prefix hold
+    identical (redundantly computed) states and EVERY level advances with
+    the same ``[N, 2^data_len]`` program — one XLA compile per field for
+    the whole walk instead of one per level width (the test suite is
+    compile-bound; the redundancy is trivial at spec-helper scale).
+    Exponential in ``data_len`` by construction: this enumerates all
+    prefixes (a spec/test helper — the server path is the
+    frontier-following sketch state, protocol/rpc.py).  Returns
     field[N, 2^(level+1), LANES(, limbs)]."""
     k = key.key
     N = k.root_seed.shape[0]
-    st = jax.tree.map(lambda a: a[:, None], dpf.eval_init(k))  # [N, 1]
+    L = data_len
+    M = 1 << L
+    st = jax.tree.map(
+        lambda a: jnp.broadcast_to(a[:, None], (N, M) + a.shape[1:]),
+        dpf.eval_init(k),
+    )  # [N, M]
+    slots = jnp.arange(M)
     shares = None
     for j in range(level + 1):
         cw = tuple(
@@ -221,26 +234,15 @@ def eval_level_full(key: SketchKeyBatch, level: int, field_t, field_u, data_len:
         )
         field = field_t if j < data_len - 1 else field_u
         cwv = (k.cw_val[:, j] if j < data_len - 1 else k.cw_val_last)[:, None]
-        m = st.t.shape[1]
-        sts, shs = [], []
-        for d in (False, True):
-            dirs = jnp.full((N, m), d)
-            ns, sh = dpf.eval_bit(
-                cw, st, dirs, cwv, k.key_idx[:, None], field, LANES
-            )
-            sts.append(ns)
-            shs.append(sh)
-        st = jax.tree.map(
-            lambda a, b: jnp.stack([a, b], axis=2).reshape((N, 2 * m) + a.shape[2:]),
-            sts[0],
-            sts[1],
+        dirs = jnp.broadcast_to(
+            ((slots >> (L - 1 - j)) & 1).astype(bool)[None], (N, M)
         )
-        shares = jax.tree.map(
-            lambda a, b: jnp.stack([a, b], axis=2).reshape((N, 2 * m) + a.shape[2:]),
-            shs[0],
-            shs[1],
+        st, shares = dpf.eval_bit(
+            cw, st, dirs, cwv, k.key_idx[:, None], field, LANES
         )
-    return shares
+    # representative slot of prefix p (level+1 bits): p << (L-1-level)
+    idx = jnp.arange(1 << (level + 1)) << (L - 1 - level)
+    return shares[:, idx]
 
 
 def verify_level(
@@ -267,7 +269,13 @@ def verify_level(
         ks0 = jax.tree.map(lambda a: a[sl], sk0)
         ks1 = jax.tree.map(lambda a: a[sl], sk1)
         n_sl = np.asarray(ks0.key.root_seed).shape[0]
-        r, rands = shared_r_stream(field, shared_seed, level, m, n_sl)
+        # draw the r vector at the full-tree width and slice: the stream
+        # program then has one shape for every level (and both servers
+        # still derive identical values — same function, same args)
+        r_full, rands = shared_r_stream(
+            field, shared_seed, level, 1 << data_len, n_sl
+        )
+        r = r_full[:m]
         states = []
         for ks in (ks0, ks1):
             pairs = eval_level_full(ks, level, field_t, field_u, data_len)

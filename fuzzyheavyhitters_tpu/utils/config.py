@@ -16,6 +16,11 @@ Extra TPU-native knobs (all defaulted so reference configs load unchanged):
   if False, the trusted-exchange mode that reveals per-(node,client)
   equality bits between the two servers (counts still travel as field
   shares toward the leader).
+- ``malicious``: if True, clients attach MAC'd sketch keys + Beaver triples
+  (protocol/sketch.py — the resurrected sketch.rs/mpc.rs path named in
+  BASELINE.json) and the servers verify every level, excluding cheating
+  clients via the liveness gate.  1-D distributions only (a one-hot sketch
+  does not extend to fuzzy L-inf balls).
 - ``f_max``: padded-frontier capacity (static device shapes).
 """
 
@@ -42,6 +47,7 @@ class Config:
     sketch_batch_size_last: int = 25_000
     backend: str = "tpu"
     secure_exchange: bool = False
+    malicious: bool = False
     f_max: int = 1024  # padded-frontier capacity (static shapes on device)
 
 

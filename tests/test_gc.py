@@ -24,10 +24,20 @@ def _strings(rng, B, S):
     return x, y, np.all(x == y, axis=1)
 
 
-@pytest.mark.parametrize("S", [1, 2, 12, 33])
+@pytest.mark.parametrize(
+    "S",
+    [
+        1,
+        pytest.param(2, marks=pytest.mark.slow),
+        pytest.param(12, marks=pytest.mark.slow),
+        33,
+    ],
+)
 def test_garble_eval_roundtrip(rng, S):
     """mask ^ decoded == [x == y] for every batch entry (the contract of
-    multiple_gb/ev_equality_test, equalitytest.rs:25-106)."""
+    multiple_gb/ev_equality_test, equalitytest.rs:25-106).  S=1 (bare XNOR,
+    no AND gates) and S=33 (odd leaf-count tree) are the edge shapes; the
+    interior sizes ride the exhaustive (-m "") run."""
     B = 16
     x, y, eq = _strings(rng, B, S)
     seed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
@@ -41,8 +51,10 @@ def test_garble_eval_roundtrip(rng, S):
 
 def test_mask_distribution(rng):
     """Output masks are per-test random bits, not constants — the garbler's
-    share must hide the plaintext result (equalitytest.rs:38-43)."""
-    B, S = 256, 4
+    share must hide the plaintext result (equalitytest.rs:38-43).  (B, S)
+    matches the roundtrip shape so the garble program compiles once; the
+    seeded rng makes the B=16 any/all checks deterministic."""
+    B, S = 16, 33
     x = rng.integers(0, 2, size=(B, S)).astype(bool)
     seed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
     _, secrets = gc.garble_equality(seed, x)
@@ -55,8 +67,9 @@ def test_mask_distribution(rng):
 
 def test_wrong_label_wrong_answer(rng):
     """Evaluating with a corrupted input label yields garbage, not the
-    correct equality bit — sanity check that the tables actually bind."""
-    B, S = 64, 8
+    correct equality bit — sanity check that the tables actually bind.
+    (B, S) matches the roundtrip shape (one compile)."""
+    B, S = 16, 33
     x, y, eq = _strings(rng, B, S)
     seed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
     batch, secrets = gc.garble_equality(seed, x)
@@ -72,7 +85,7 @@ def test_delta_garble_matches_plain(rng):
     """The Δ-OT form: labels delivered as T_j = Q_j ^ y_j*s must evaluate to
     the same shared equality as the explicit form."""
     snd, rcv = otext.inprocess_pair()
-    B, S = 33, 6
+    B, S = 16, 33
     x, y, eq = _strings(rng, B, S)
     u, t_rows = rcv.extend(y.reshape(B * S))
     q = snd.extend(B * S, np.asarray(u))

@@ -64,9 +64,12 @@ def test_mesh_matches_colocated_driver(client_batch, colocated_result, cpu_devic
     assert got == colocated_result
 
 
+@pytest.mark.slow
 def test_mesh_two_devices(client_batch, colocated_result, cpu_devices):
     """Minimal mesh: just the 2-server axis, no data parallelism — the
-    2-chip deployment shape from BASELINE.md's north star."""
+    2-chip deployment shape from BASELINE.md's north star.  Marked slow:
+    it re-compiles the whole crawl kernel family for a second mesh shape;
+    the 2x4 mesh parity test covers the same code path."""
     _, k0, k1, _, _, n = client_batch
     m = meshmod.make_mesh(devices=cpu_devices[:2])
     runner = meshmod.MeshRunner(m, k0, k1, f_max=128)
@@ -74,33 +77,19 @@ def test_mesh_two_devices(client_batch, colocated_result, cpu_devices):
     assert got == colocated_result
 
 
-def test_mesh_secure_matches_trusted(cpu_devices):
+def test_mesh_secure_matches_trusted(client_batch, colocated_result, cpu_devices):
     """The GC+OT 2PC on the 2×4 mesh (four ppermute transfers per level on
     the servers axis, FE62 inner levels + F255 last level) reconstructs the
-    exact trusted-mode heavy hitters."""
-    rng = np.random.default_rng(11)
-    L, d, n = 4, 2, 16
-    centers = np.array([[3, 12], [9, 5]])
-    pts = centers[rng.integers(0, 2, size=n)] + rng.integers(-1, 2, size=(n, d))
-    pts = np.clip(pts, 0, (1 << L) - 1)
-    pts_bits = np.array(
-        [[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts]
-    )
-    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 1, rng, engine="np")
-
-    with jax.default_device(cpu_devices[0]):
-        s0, s1 = driver.make_servers(k0, k1)
-        want = _as_dict(
-            driver.Leader(s0, s1, n_dims=d, data_len=L, f_max=32).run(
-                nreqs=n, threshold=0.25
-            )
-        )
-    assert want
+    exact trusted-mode heavy hitters.  Same scenario as the trusted parity
+    test, so the oracle and the trusted kernel family compile once for the
+    module."""
+    _, k0, k1, _, _, n = client_batch
+    assert colocated_result
 
     m = meshmod.make_mesh(devices=cpu_devices)
-    runner = meshmod.MeshRunner(m, k0, k1, f_max=32, secure_exchange=True)
-    got = _as_dict(meshmod.MeshLeader(runner).run(nreqs=n, threshold=0.25))
-    assert got == want
+    runner = meshmod.MeshRunner(m, k0, k1, f_max=128, secure_exchange=True)
+    got = _as_dict(meshmod.MeshLeader(runner).run(nreqs=n, threshold=0.1))
+    assert got == colocated_result
 
 
 def test_odd_device_count_rejected(cpu_devices):

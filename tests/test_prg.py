@@ -22,6 +22,23 @@ def test_jax_matches_numpy_block(rng):
     np.testing.assert_array_equal(out_np, out_jax)
 
 
+def test_unrolled_rounds_bit_exact(rng):
+    """CHACHA_UNROLL (the TPU hot-path form, bin/server.py + bench.py) and
+    the default scan form compute identical blocks."""
+    import jax
+
+    blocks = rng.integers(0, 2**32, size=(8, 4), dtype=np.uint32)
+    want = prg.np_chacha_block(blocks)
+    old = prg.CHACHA_UNROLL
+    try:
+        prg.CHACHA_UNROLL = True
+        # fresh trace: chacha_block reads the flag at trace time
+        got = np.asarray(jax.jit(lambda b: prg.chacha_block(b))(blocks))
+    finally:
+        prg.CHACHA_UNROLL = old
+    np.testing.assert_array_equal(got, want)
+
+
 def test_expand_matches_bytes_interface(rng):
     for _ in range(8):
         seed = rng.bytes(16)

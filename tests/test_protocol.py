@@ -37,7 +37,7 @@ def brute_force_hitters(pts, ball, L, thresh):
     return out
 
 
-def run_protocol(pts, ball, L, threshold, f_max=512):
+def run_protocol(pts, ball, L, threshold, f_max=128):
     pts = np.asarray(pts)
     n, d = pts.shape
     rng = np.random.default_rng(99)
@@ -63,7 +63,7 @@ def test_heavy_hitters_match_brute_force(rng, d, L, ball):
     pts = centers[rng.integers(0, 4, size=n)] + rng.integers(-1, 2, size=(n, d))
     pts = np.clip(pts, 0, (1 << L) - 1)
     threshold = 0.1  # thresh = max(1, 4)
-    got = run_protocol(pts, ball, L, threshold)
+    got = run_protocol(pts, ball, L, threshold, f_max=512 if d == 2 else 128)
     want = brute_force_hitters(pts, ball, L, max(1, int(threshold * n)))
     assert got == want
 

@@ -17,6 +17,14 @@ from fuzzyheavyhitters_tpu.utils.config import Config
 BASE_PORT = 39131
 
 
+@pytest.fixture(autouse=True)
+def _module_cpu(cpu_default):
+    """CPU backend: the RPC layer under test is host-side glue; its device
+    programs are the same crawl kernels test_protocol.py compiles (shapes
+    harmonized), and every remote-tunnel compile costs ~10 s flat."""
+    yield
+
+
 def _cfg(**kw):
     defaults = dict(
         data_len=6,
@@ -55,9 +63,11 @@ async def _run_protocol(cfg, keys0, keys1, nreqs, port0, port1):
 
 
 def test_rpc_protocol_matches_colocated(rng):
-    L, d, n = 6, 1, 24
+    # (L, d, n, f_max) match test_protocol.py's d=1 scenarios so the crawl
+    # kernels compile once for both files
+    L, d, n = 6, 1, 40
     cfg = _cfg(data_len=L, n_dims=d)
-    pts = np.concatenate([np.full(16, 20), rng.integers(0, 1 << L, size=8)])[:, None]
+    pts = np.concatenate([np.full(32, 20), rng.integers(0, 1 << L, size=8)])[:, None]
     pts_bits = np.array([[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts])
     k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, cfg.ball_size, rng)
 

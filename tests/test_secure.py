@@ -36,7 +36,7 @@ def test_pipeline_sans_io(ot_pair, rng, field):
     (the r1-r0=1 trick, ref: collect.rs:439-471; F255 payloads ride two
     blocks — the BlockPair double OT of collect.rs:775-916)."""
     snd, rcv = ot_pair
-    B, S = 65, 4
+    B, S = 16, 33  # matches test_gc's delta shape -> shared compiles
     x = rng.integers(0, 2, size=(B, S)).astype(bool)
     y = x.copy()
     flip = rng.integers(0, 2, size=B).astype(bool)
@@ -64,7 +64,7 @@ def test_evaluator_share_is_masked(ot_pair, rng):
     """The evaluator's GC output alone must not reveal equality: its share
     differs from the plaintext wherever the garbler's mask bit is set."""
     snd, rcv = ot_pair
-    B, S = 128, 2
+    B, S = 16, 33  # same shape as the pipeline test (one garble program)
     x = rng.integers(0, 2, size=(B, S)).astype(bool)
     u, t_rows = secure.ev_step1(rcv, x)  # y == x: all equal
     gc_seed = np.frombuffer(pysecrets.token_bytes(16), "<u4")

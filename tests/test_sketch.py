@@ -42,7 +42,7 @@ def test_dpf_one_hot_reconstruction(rng):
     """share0 + share1 is the payload at the client's prefix, 0 elsewhere —
     at every level, both fields (the BGI payload-DPF contract the sketch
     rides on, ref: sketch.rs:8-24)."""
-    N, L, lanes = 3, 4, 2
+    N, L, lanes = 6, 5, 2  # (N, L) match _gen so eval programs compile once
     alpha = rng.integers(0, 2, size=(N, L)).astype(bool)
     seeds = rng.integers(0, 2**32, size=(N, 2, 4), dtype=np.uint32)
     vals = jnp.asarray(rng.integers(1, 100, size=(N, L - 1, lanes)).astype(np.uint64))
@@ -114,7 +114,7 @@ def test_inconsistent_mac_key_share_flagged(rng):
 
 def test_sketch_batch_chunking_equivalent(rng):
     """sketch_batch_size chunking must not change verdicts."""
-    _, sk0, sk1, shared, L = _gen(rng, N=7)
+    _, sk0, sk1, shared, L = _gen(rng)  # N=6: bs=3 -> two equal chunks
     a = sketch.verify_level(sk0, sk1, 2, FE62, F255, L, shared,
                             sketch_batch_size=100_000)
     b = sketch.verify_level(sk0, sk1, 2, FE62, F255, L, shared,
@@ -156,9 +156,11 @@ BASE_PORT = 39531
 
 
 def test_malformed_key_excluded_from_counts(rng):
-    L, n = 5, 8
-    # clients 0..5 at point 11, clients 6,7 elsewhere; client 3 cheats
-    pts = np.array([[11]] * 6 + [[25], [2]])
+    # (L, n, f_max, d) match test_secure.py's socket e2e so the trusted
+    # crawl kernels compile once for both files
+    L, n = 5, 12
+    # clients 0..7 at point 11, clients 8..11 elsewhere; client 3 cheats
+    pts = np.array([[11]] * 8 + [[25], [2], [50], [60]])
     pts_bits = np.array(
         [[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts]
     )
@@ -175,7 +177,7 @@ def test_malformed_key_excluded_from_counts(rng):
     sk1 = sk1._replace(key=sk1.key._replace(cw_val=j))
 
     cfg = Config(
-        data_len=L, n_dims=1, ball_size=1, addkey_batch_size=8, num_sites=4,
+        data_len=L, n_dims=1, ball_size=1, addkey_batch_size=12, num_sites=4,
         threshold=0.5, zipf_exponent=1.03,
         server0=f"127.0.0.1:{BASE_PORT}", server1=f"127.0.0.1:{BASE_PORT + 10}",
         distribution="zipf", f_max=32, sketch_batch_size=100_000,
@@ -202,13 +204,13 @@ def test_malformed_key_excluded_from_counts(rng):
 
     res, alive = asyncio.run(run())
     # the cheater was excluded exactly
-    np.testing.assert_array_equal(
-        alive, np.array([1, 1, 1, 0, 1, 1, 1, 1], bool)
-    )
+    want_alive = np.ones(n, bool)
+    want_alive[3] = False
+    np.testing.assert_array_equal(alive, want_alive)
     got = {
         tuple(int(v) for v in r): int(c)
         for r, c in zip(res.decode_ints(), res.counts)
     }
-    # threshold 0.5*8 = 4: the 5 honest clients at 11 clear it; counts
-    # exclude the cheater (5, not 6)
-    assert got == {(10,): 5, (11,): 5, (12,): 5}
+    # threshold 0.5*12 = 6: the 7 honest clients at 11 clear it; counts
+    # exclude the cheater (7, not 8)
+    assert got == {(10,): 7, (11,): 7, (12,): 7}
