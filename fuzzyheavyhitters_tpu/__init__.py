@@ -20,7 +20,7 @@ Layout (mirrors the reference's layer map, SURVEY.md §1):
                                                              src/bin/*.rs)
 - ``workloads`` zipf / rides / covid samplers + CSV output   (ref: src/sample_*.rs)
 
-64-bit integer support is required for the fast 62-bit field (``ops.field62``);
+64-bit integer support is required for the fast 62-bit field (``ops.fields``);
 we enable it here, before any JAX arrays are created.
 """
 

@@ -1,0 +1,82 @@
+"""Native runtime components (C++ via ctypes — no pybind11 in this image).
+
+The reference's data loaders are native Rust streaming multi-GB CSVs
+(memmap row indexing + seeded reservoir, src/sample_covid_data.rs:75-166,
+src/sample_driving_data.rs:72-97).  This package holds their C++
+equivalents, compiled lazily with the system ``g++`` on first use and
+cached next to the source; every caller has a pure-NumPy fallback, so the
+framework stays importable where no toolchain exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(__file__)
+_SRC = os.path.join(_DIR, "reservoir.cc")
+_LIB = os.path.join(_DIR, "libreservoir.so")
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _load():
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not os.path.exists(_LIB) or (
+            os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        ):
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _LIB],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except Exception:
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+            lib.csv_reservoir_sample.restype = ctypes.c_long
+            lib.csv_reservoir_sample.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_long,
+                ctypes.c_ulonglong,
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            ]
+            _lib = lib
+        except OSError:
+            _build_failed = True
+    return _lib
+
+
+def available() -> bool:
+    """True when the native loader is (or can be) built and loaded."""
+    return _load() is not None
+
+
+def csv_reservoir_sample(
+    path: str, col_a: int, col_b: int, k: int, seed: int
+) -> np.ndarray | None:
+    """Reservoir-sample ``k`` rows' (col_a, col_b) floats from a CSV in one
+    streaming pass with O(k) memory.  Returns float64[kept, 2], or None when
+    the native library is unavailable (callers fall back to NumPy)."""
+    lib = _load()
+    if lib is None:
+        return None
+    out_a = np.empty(k, np.float64)
+    out_b = np.empty(k, np.float64)
+    kept = lib.csv_reservoir_sample(
+        path.encode(), col_a, col_b, k, seed & (2**64 - 1), out_a, out_b
+    )
+    if kept < 0:
+        raise FileNotFoundError(path)
+    return np.stack([out_a[:kept], out_b[:kept]], axis=1)

@@ -303,11 +303,17 @@ def _rng_seeds(rng: np.random.Generator, shape) -> np.ndarray:
 
 
 def _gen(engine: str):
-    """Select the keygen implementation: "jax" (device) or "np" (host)."""
+    """Select the keygen implementation: "jax" (device scan), "np" (host),
+    or "pallas" (the fused single-kernel TPU engine, ops/keygen_pallas.py —
+    ~5x the scan engine's throughput on the chip)."""
     if engine == "jax":
         return gen_pair
     if engine == "np":
         return gen_pair_np
+    if engine == "pallas":
+        from .keygen_pallas import gen_pair_pallas
+
+        return gen_pair_pallas
     raise ValueError(f"unknown keygen engine {engine!r}")
 
 

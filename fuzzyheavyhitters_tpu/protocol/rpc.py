@@ -483,13 +483,18 @@ class CollectorServer:
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
-            # Drain, never cancel: a verb may be mid-_swap on the PERSISTENT
+            # Drain, don't cancel: a verb may be mid-_swap on the PERSISTENT
             # peer data plane — cancelling between its send and recv would
             # leave the peer's frame unread and desynchronize every later
             # exchange (the old sequential loop always finished the verb in
-            # flight; concurrent handling must keep that guarantee).
+            # flight; concurrent handling must keep that guarantee).  The
+            # timeout covers the one case draining can't: the verb is stuck
+            # on a DEAD peer — then the data plane is already lost and
+            # cancelling costs nothing.
             if tasks:
-                await asyncio.gather(*tasks, return_exceptions=True)
+                done, pending = await asyncio.wait(tasks, timeout=120)
+                for t in pending:
+                    t.cancel()
             writer.close()
 
     async def start(self, host: str, port: int, peer_host: str, peer_port: int):

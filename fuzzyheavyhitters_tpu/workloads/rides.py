@@ -36,20 +36,34 @@ def int_to_geo(lat_int: int, lng_int: int) -> tuple[float, float]:
 def sample_start_locations(
     path: str, sample_size: int, seed: int | None = None
 ) -> np.ndarray:
-    """int16[sample_size, 2] (lat, lon) centidegrees sampled without
-    replacement from the RideAustin CSV — columns 14 (start lat) and 13
-    (start lon), matching the reference's indexing
-    (ref: sample_driving_data.rs:72-97)."""
-    rng = np.random.default_rng(seed)
-    with open(path, newline="") as f:
-        reader = csv.reader(f)
-        next(reader)  # header
-        rows = [r for r in reader]
-    take = rng.choice(len(rows), size=min(sample_size, len(rows)), replace=False)
-    out = []
-    for i in take:
-        lat, lon = float(rows[i][14]), float(rows[i][13])
-        out.append(geo_to_int(lat, lon))
+    """int16[sample_size, 2] (lat, lon) centidegrees sampled from the
+    RideAustin CSV — columns 14 (start lat) and 13 (start lon), matching
+    the reference's indexing (ref: sample_driving_data.rs:72-97).
+
+    Uses the native streaming reservoir sampler (one pass, O(k) memory —
+    the multi-GB-CSV regime the reference's Rust loaders run in,
+    fuzzyheavyhitters_tpu/native/) when the toolchain allows, else an
+    in-memory NumPy fallback."""
+    from .. import native
+
+    if seed is None:  # stay random per call, matching the NumPy fallback
+        seed = int(np.random.default_rng().integers(0, 2**63))
+    coords = native.csv_reservoir_sample(
+        path, col_a=14, col_b=13, k=sample_size, seed=seed
+    )
+    if coords is None:  # pure-Python fallback: load-all + choice
+        rng = np.random.default_rng(seed)
+        with open(path, newline="") as f:
+            reader = csv.reader(f)
+            next(reader)  # header
+            rows = [r for r in reader]
+        take = rng.choice(
+            len(rows), size=min(sample_size, len(rows)), replace=False
+        )
+        coords = np.array(
+            [[float(rows[i][14]), float(rows[i][13])] for i in take]
+        )
+    out = [geo_to_int(lat, lon) for lat, lon in coords]
     return np.array(out, dtype=np.int16)
 
 

@@ -79,6 +79,20 @@ def test_counter_lockstep(pair, rng):
     assert not np.array_equal(outs[1], outs[2])
 
 
+@pytest.mark.slow
+def test_ragged_extend_sizes(pair, rng):
+    """Non-block-multiple m values exercise the partial-word padding and
+    counter-advance rounding directly (the default run covers the ragged
+    path via the GC delta test's m=528; this sweeps it explicitly)."""
+    snd, rcv = pair
+    for m in (33, 32, 7, 77):
+        r = rng.integers(0, 2, size=m).astype(bool)
+        u, t = rcv.extend(r)
+        q = snd.extend(m, np.asarray(u))
+        want = np.where(r[:, None], np.asarray(q) ^ snd.s_block, np.asarray(q))
+        np.testing.assert_array_equal(np.asarray(t), want)
+
+
 def test_pack_unpack_roundtrip(rng):
     for m in (1, 31, 32, 33, 128, 129):
         bits = rng.integers(0, 2, size=m).astype(bool)

@@ -169,3 +169,54 @@ def test_visualization_scripts_render(tmp_path):
         out_dir=str(tmp_path / "covid_plots"),
     )
     assert len(out) == 3 and all(os.path.getsize(p) > 1000 for p in out)
+
+
+# ---------------------------------------------------------------------------
+# Native streaming reservoir sampler (fuzzyheavyhitters_tpu/native)
+# ---------------------------------------------------------------------------
+
+
+def test_native_reservoir_sampler(tmp_path):
+    from fuzzyheavyhitters_tpu import native
+
+    if not native.available():
+        pytest.skip("no C++ toolchain")
+    p = tmp_path / "rides.csv"
+    rows = [(30.0 + i * 0.01, -97.0 - i * 0.01) for i in range(50)]
+    with open(p, "w") as f:
+        f.write("h0,h1,h2\n")
+        for lat, lon in rows:
+            # col 1 = lon (quoted, like real exports), col 2 = lat
+            f.write(f'x,"{lon}",{lat}\n')
+    # k >= rows: every row comes back, in file order
+    got = native.csv_reservoir_sample(str(p), col_a=2, col_b=1, k=100, seed=7)
+    np.testing.assert_allclose(got, np.array(rows))
+    # k < rows: deterministic for a seed, k rows, all from the file
+    a = native.csv_reservoir_sample(str(p), col_a=2, col_b=1, k=8, seed=7)
+    b = native.csv_reservoir_sample(str(p), col_a=2, col_b=1, k=8, seed=7)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (8, 2)
+    all_rows = {tuple(r) for r in np.round(np.array(rows), 6)}
+    assert all(tuple(r) in all_rows for r in np.round(a, 6))
+    # different seed -> (almost surely) different reservoir
+    c = native.csv_reservoir_sample(str(p), col_a=2, col_b=1, k=8, seed=8)
+    assert not np.array_equal(a, c)
+
+
+def test_rides_sampler_uses_native_path(tmp_path):
+    from fuzzyheavyhitters_tpu.workloads import rides
+
+    p = tmp_path / "RideAustin.csv"
+    hdr = ",".join(f"c{i}" for i in range(16))
+    with open(p, "w") as f:
+        f.write(hdr + "\n")
+        for i in range(20):
+            row = ["0"] * 16
+            row[13] = str(-97.70 - i * 0.01)  # start lon
+            row[14] = str(30.20 + i * 0.01)  # start lat
+            f.write(",".join(row) + "\n")
+    out = rides.sample_start_locations(str(p), 5, seed=3)
+    assert out.shape == (5, 2) and out.dtype == np.int16
+    # centidegree range of the crafted coordinates
+    assert np.all((out[:, 0] >= 3020) & (out[:, 0] <= 3040))
+    assert np.all((out[:, 1] <= -9770) & (out[:, 1] >= -9790))
