@@ -51,22 +51,38 @@ def _key_wire_bytes(k0) -> int:
     return per
 
 
-def _throughput(jnp, gen, seeds_d, alpha_d, side_d, n, iters=20, trials=3):
-    """Steady-state keys/sec: queue ``iters`` keygen launches and force them
-    with ONE sync whose value depends on every launch.  A per-iteration
-    scalar fetch adds a full tunnel round trip to each measurement (~100 ms
-    — 3x the kernel itself at these sizes); a bare block_until_ready through
-    the tunnel returns before the device finishes.  The dependent-sum sync
-    is honest and amortized; taking the MIN over trials strips the tunnel's
-    additive queueing noise (which otherwise swings results 3-5x)."""
-    k0, _ = gen(seeds_d, alpha_d, side_d)
-    int(jnp.sum(k0.cw_seed.astype(jnp.uint32)))  # compile + warm
+def _steady_state_seconds(thunk, force, warm_force, iters=20, trials=3):
+    """Min-of-trials per-launch seconds for a device thunk.
+
+    Queues ``iters`` launches and forces them with ONE sync whose value
+    depends on every launch (``force`` maps the list of outputs to a host
+    int).  A per-iteration scalar fetch adds a full tunnel round trip to
+    each measurement (~100 ms — 3x the kernel itself at bench sizes); a
+    bare block_until_ready through the tunnel returns before the device
+    finishes.  The dependent sync is honest and amortized; the MIN over
+    trials strips the tunnel's additive queueing noise (which otherwise
+    swings results 3-5x)."""
+    warm_force(thunk())  # compile + warm
     best = float("inf")
     for _ in range(trials):
         t0 = time.perf_counter()
-        outs = [gen(seeds_d, alpha_d, side_d)[0] for _ in range(iters)]
-        int(sum(jnp.sum(o.cw_seed[0, 0, 0].astype(jnp.uint32)) for o in outs))
+        force([thunk() for _ in range(iters)])
         best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def _throughput(jnp, gen, seeds_d, alpha_d, side_d, n, iters=20, trials=3):
+    """Steady-state keygen keys/sec (see _steady_state_seconds)."""
+    k0, _ = gen(seeds_d, alpha_d, side_d)
+    best = _steady_state_seconds(
+        lambda: gen(seeds_d, alpha_d, side_d)[0],
+        lambda outs: int(
+            sum(jnp.sum(o.cw_seed[0, 0, 0].astype(jnp.uint32)) for o in outs)
+        ),
+        lambda k: int(jnp.sum(k.cw_seed.astype(jnp.uint32))),
+        iters=iters,
+        trials=trials,
+    )
     return n / best, k0
 
 
@@ -130,7 +146,6 @@ def bench_crawl(ibdcf, driver, rng, n=8192, L=512, f_max=64):
     # latency measures the tunnel, not the chip, and disappears when the
     # leader runs adjacent to the TPU, so the throughput/1M-client numbers
     # come from the device measurement (e2e slice reported alongside).
-    import jax
     import jax.numpy as jnp
 
     from fuzzyheavyhitters_tpu.protocol import collect
@@ -163,13 +178,12 @@ def bench_crawl(ibdcf, driver, rng, n=8192, L=512, f_max=64):
         f1 = collect.advance(s1.keys, s1.frontier, lvl, parent, pat, n_alive)
         return cnt, f0, f1
 
-    int(jnp.sum(one_level(timed_levels)[0]))  # warm
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        outs = [one_level(timed_levels) for _ in range(16)]
-        int(sum(jnp.sum(c[0, 0]) for c, _, _ in outs))
-        best = min(best, (time.perf_counter() - t0) / 16)
+    best = _steady_state_seconds(
+        lambda: one_level(timed_levels),
+        lambda outs: int(sum(jnp.sum(c[0, 0]) for c, _, _ in outs)),
+        lambda o: int(jnp.sum(o[0])),
+        iters=16,
+    )
     dt = best * L
     return {
         "aggregate_clients_per_sec": round(n / dt, 1),

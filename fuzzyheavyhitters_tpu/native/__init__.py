@@ -33,16 +33,25 @@ def _load():
         if not os.path.exists(_LIB) or (
             os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
         ):
+            # build to a private temp path and rename into place: the
+            # rename is atomic, so concurrent builders never dlopen a
+            # half-written artifact and long-running processes keep their
+            # already-mapped inode (truncating in place could SIGBUS them)
+            tmp = f"{_LIB}.build.{os.getpid()}"
             try:
                 subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _LIB],
+                    ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", tmp],
                     check=True,
                     capture_output=True,
                     timeout=120,
                 )
+                os.replace(tmp, _LIB)
             except Exception:
                 _build_failed = True
                 return None
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
         try:
             lib = ctypes.CDLL(_LIB)
             lib.csv_reservoir_sample.restype = ctypes.c_long

@@ -1,0 +1,111 @@
+"""Fused Pallas ibDCF eval step — the crawl's per-level chip hot loop.
+
+``advance`` (protocol/collect.py) spends its time in one place: the PRG
+expansion of every surviving (node, client, dim, side) state
+(ref: ibDCF.rs:208-227 ``eval_bit``; the reference loops it per key,
+collect.rs:94-119).  The XLA version runs at ~12 ns per ChaCha block on the
+chip; this kernel runs the same step at the keygen kernel's ~4 ns by
+keeping the cipher state in registers with the flat state index spread
+over (row, sublane, lane) — every ChaCha word is a [R_BLK, 8, 128] vreg
+batch (layout family of ops/keygen_pallas.py).
+
+Scope: exactly one level advance on FLAT state tensors — the caller keeps
+the parent gather, direction select of correction bits, and reshapes in
+XLA (they are bandwidth-trivial), so the kernel is a pure map with no
+dynamic indexing.  Bit-exact vs ops/ibdcf._eval_bit_jit in both bit modes
+(tests/test_keygen_pallas.py); opt in via ``collect.EVAL_PALLAS = True``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .keygen_pallas import LANES, SUB, _chacha16
+
+R_BLK = 64  # row-groups per grid step: 64 * 8 * 128 = 64Ki states/step
+
+
+def _kernel(derived_bits: bool,
+            seed_ref, t_ref, y_ref, dir_ref, cws_ref, cwb_ref, cwy_ref,
+            oseed_ref, obit_ref, oy_ref):
+    """One row block, all u32 (flags as 0/1 words, selects as XOR-masks;
+    Mosaic rejects vector i1).  Shapes: seed/cw_seed u32[4, R_BLK, 8,
+    LANES], everything else u32[R_BLK, 8, LANES]."""
+    d = dir_ref[...]
+    t = t_ref[...]
+    dm = jnp.uint32(0) - d
+    tm = jnp.uint32(0) - t
+
+    blk = [seed_ref[w] for w in range(4)]
+    blk[0] = blk[0] & jnp.uint32(0xFFFFFFF0)  # prg.rs:97 mask
+    out = _chacha16(blk)
+    for w in range(4):
+        # child seed by direction, then the t-gated correction
+        child = out[w] ^ (dm & (out[w] ^ out[4 + w]))
+        oseed_ref[w] = child ^ (tm & cws_ref[w])
+    if derived_bits:
+        w8 = out[8]
+        b_l, b_r = (w8 & 1) ^ 1, ((w8 >> 1) & 1) ^ 1
+        y_l, y_r = ((w8 >> 2) & 1) ^ 1, ((w8 >> 3) & 1) ^ 1
+        tau_b = b_l ^ (d & (b_l ^ b_r))
+        tau_y = y_l ^ (d & (y_l ^ y_r))
+    else:  # the reference's masked-byte constants (prg.rs:103-104)
+        tau_b = jnp.full(d.shape, 1, jnp.uint32)
+        tau_y = tau_b
+    obit_ref[...] = tau_b ^ (t & cwb_ref[...])
+    oy_ref[...] = tau_y ^ (t & cwy_ref[...]) ^ y_ref[...]
+
+
+@partial(jax.jit, static_argnames=("derived_bits",))
+def eval_bit_flat(seed, t, y, direction, cw_seed, cw_b_d, cw_y_d,
+                  derived_bits: bool):
+    """Advance B flat states one level.
+
+    seed/cw_seed: u32[B, 4]; t, y, direction, cw_b_d, cw_y_d: bool[B]
+    (cw bits already direction-selected).  Returns (seed' u32[B, 4],
+    bit' bool[B], y' bool[B]) — the same recurrence as
+    ibdcf._eval_bit_jit on flattened tensors.
+    """
+    from jax.experimental import pallas as pl
+
+    B = seed.shape[0]
+    group = SUB * LANES
+    pad = (-B) % (group * R_BLK)
+    bp = B + pad
+    rows = bp // group
+
+    def flags(a):
+        a = jnp.asarray(a, jnp.uint32)
+        if pad:
+            a = jnp.concatenate([a, jnp.zeros((pad,), jnp.uint32)])
+        return a.reshape(rows, SUB, LANES)
+
+    def words(a):
+        a = jnp.asarray(a, jnp.uint32)
+        if pad:
+            a = jnp.concatenate([a, jnp.zeros((pad, 4), jnp.uint32)])
+        return jnp.transpose(a.reshape(rows, SUB, LANES, 4), (3, 0, 1, 2))
+
+    z = np.int32(0)
+    spec4 = pl.BlockSpec((4, R_BLK, SUB, LANES), lambda j: (z, j, z, z))
+    spec1 = pl.BlockSpec((R_BLK, SUB, LANES), lambda j: (j, z, z))
+    oseed, obit, oy = pl.pallas_call(
+        partial(_kernel, derived_bits),
+        grid=(rows // R_BLK,),
+        in_specs=[spec4, spec1, spec1, spec1, spec4, spec1, spec1],
+        out_specs=[spec4, spec1, spec1],
+        out_shape=[
+            jax.ShapeDtypeStruct((4, rows, SUB, LANES), jnp.uint32),
+            jax.ShapeDtypeStruct((rows, SUB, LANES), jnp.uint32),
+            jax.ShapeDtypeStruct((rows, SUB, LANES), jnp.uint32),
+        ],
+    )(words(seed), flags(t), flags(y), flags(direction),
+      words(cw_seed), flags(cw_b_d), flags(cw_y_d))
+    oseed = jnp.transpose(oseed, (1, 2, 3, 0)).reshape(bp, 4)[:B]
+    obit = obit.reshape(bp)[:B] != 0
+    oy = oy.reshape(bp)[:B] != 0
+    return oseed, obit, oy

@@ -80,7 +80,8 @@ def _hash_many(labels: jax.Array, gate_ids: jax.Array, halves) -> jax.Array:
     h = jnp.asarray(halves, jnp.uint32).reshape((m,) + (1,) * (labels.ndim - 2))
     x = labels ^ tweak
     x = x.at[..., 1].set(x[..., 1] ^ h)  # half selector = tweak word 1
-    return prg.chacha_block(x)[..., :4]
+    # fusion fence before slicing (see prg._expand_jit's rationale)
+    return jax.lax.optimization_barrier(prg.chacha_block(x))[..., :4]
 
 
 def _maskw(bit: jax.Array, block: jax.Array) -> jax.Array:

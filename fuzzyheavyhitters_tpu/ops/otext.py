@@ -130,7 +130,8 @@ def ot_hash(rows: jax.Array, n_words: int, idx_offset=0) -> jax.Array:
         ],
         axis=-1,
     )
-    return prg.chacha_block(rows ^ tweak)[..., :n_words]
+    # fusion fence before slicing (see prg._expand_jit's rationale)
+    return jax.lax.optimization_barrier(prg.chacha_block(rows ^ tweak))[..., :n_words]
 
 
 def s_to_block(s_bits: np.ndarray) -> np.ndarray:

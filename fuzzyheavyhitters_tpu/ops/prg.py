@@ -133,18 +133,9 @@ def chacha_block(block: jax.Array) -> jax.Array:
         (a, b, c, d), _ = jax.lax.scan(
             _double_round, (a, b, c, d), None, length=N_ROUNDS // 2
         )
-    out = jnp.concatenate(
+    return jnp.concatenate(
         [x + y for x, y in zip((a, b, c, d), init)], axis=-1
     )
-    # Fusion fence: without it, XLA:CPU's loop-fusion emitter re-evaluates
-    # the entire ~400-op ChaCha DAG once per consumer output element when a
-    # consumer slices this block (e.g. out[..., 0:4]), which turns kernels
-    # that output seed tensors (keygen scan, advance) into hour-scale
-    # compiles.  Measured: a shard_mapped expand->slice at [128,32,2,2] hung
-    # >300 s without the barrier, 2.5 s with it.  The cost elsewhere is ~nil:
-    # the block is materialized at kernel boundaries anyway, and TPU bench
-    # throughput is re-checked in bench.py.
-    return jax.lax.optimization_barrier(out)
 
 
 def mask_seed(seed: jax.Array) -> jax.Array:
@@ -171,6 +162,15 @@ def expand(seed: jax.Array, derived_bits: bool | None = None):
 def _expand_jit(seed: jax.Array, derived_bits: bool):
     seed = mask_seed(seed)
     out = chacha_block(seed)
+    # Fusion fence on the child-seed slices: without it, XLA:CPU's
+    # loop-fusion emitter re-evaluates the whole ChaCha DAG once per
+    # consumer output element when a consumer slices the block (measured: a
+    # shard_mapped expand->slice at [128,32,2,2] hung >300 s compiling,
+    # 2.5 s with the fence).  The fence sits HERE, not in chacha_block, so
+    # that callers which consume only the t/y bits (the packed share-bit
+    # expansion in default bit mode — the bits are constants there) let
+    # the entire dead cipher evaluation fall to DCE.
+    out = jax.lax.optimization_barrier(out)
     s_l = out[..., 0:4]
     s_r = out[..., 4:8]
     if derived_bits:
@@ -204,7 +204,8 @@ def stream_blocks(seed: jax.Array, n_blocks: int, offset=0) -> jax.Array:
         seed[..., None, :], seed.shape[:-1] + (n_blocks, 4)
     )
     blocks = blocks.at[..., 0].add(ctr)
-    return chacha_block(blocks)
+    # same fusion fence as _expand_jit: stream consumers slice the block
+    return jax.lax.optimization_barrier(chacha_block(blocks))
 
 
 def stream_words(seed: jax.Array, n_words: int) -> jax.Array:

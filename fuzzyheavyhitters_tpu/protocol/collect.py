@@ -46,6 +46,13 @@ from ..ops.ibdcf import EvalState, IbDcfKeyBatch
 
 MAX_DIMS = 8  # packed-u32 layout holds d*4 bits
 
+# Advance-step engine, read at TRACE time: True routes the per-level eval
+# expansion through the fused Pallas kernel (ops/eval_pallas.py).  Opt-in
+# and TPU-only (the mesh/shard_map path always uses XLA): measured
+# net-neutral at bench sizes through the remote-chip tunnel, kept for
+# locally-attached chips where dispatch overhead is not the floor.
+EVAL_PALLAS = False
+
 
 class Frontier(NamedTuple):
     """Per-server frontier state for ``F`` (padded) tree nodes.
@@ -169,19 +176,47 @@ def advance(
     pair walks together, ref: collect.rs:100, ibDCF.rs:120-131).
     """
     return _advance_jit(
-        keys, frontier, level, parent_idx, pattern_bits, n_alive, prg.DERIVED_BITS
+        keys, frontier, level, parent_idx, pattern_bits, n_alive,
+        prg.DERIVED_BITS, EVAL_PALLAS,
     )
 
 
-@partial(jax.jit, static_argnames=("derived_bits",))
-def _advance_jit(keys, frontier, level, parent_idx, pattern_bits, n_alive, derived_bits):
+@partial(jax.jit, static_argnames=("derived_bits", "use_pallas"))
+def _advance_jit(keys, frontier, level, parent_idx, pattern_bits, n_alive,
+                 derived_bits, use_pallas=False):
     cw = ibdcf.level_cw(keys, level)
     st = frontier.states
     parents = jax.tree.map(lambda a: a[parent_idx], st)  # [F', N, d, 2]
     direction = jnp.broadcast_to(
         pattern_bits[:, None, :, None], parents.bit.shape
     )  # child pattern bit of each dim, same for both keys of the dim
-    states = ibdcf._eval_bit_jit(cw, parents, direction, derived_bits)
+    if use_pallas:
+        from ..ops import eval_pallas
+
+        cw_seed, cw_bits, cw_y = cw  # [N, d, 2, 4], [N, d, 2, 2]
+        shp = parents.bit.shape  # [F', N, d, 2]
+        # direction-select the cw bits and broadcast over the node axis in
+        # XLA (bandwidth-trivial); the kernel is a pure flat map
+        cwb_d = jnp.where(direction, cw_bits[None, ..., 1], cw_bits[None, ..., 0])
+        cwy_d = jnp.where(direction, cw_y[None, ..., 1], cw_y[None, ..., 0])
+        cws_b = jnp.broadcast_to(cw_seed[None], shp + (4,))
+        seed2, bit2, y2 = eval_pallas.eval_bit_flat(
+            parents.seed.reshape(-1, 4),
+            parents.bit.reshape(-1),
+            parents.y_bit.reshape(-1),
+            direction.reshape(-1),
+            cws_b.reshape(-1, 4),
+            cwb_d.reshape(-1),
+            cwy_d.reshape(-1),
+            derived_bits,
+        )
+        states = EvalState(
+            seed=seed2.reshape(shp + (4,)),
+            bit=bit2.reshape(shp),
+            y_bit=y2.reshape(shp),
+        )
+    else:
+        states = ibdcf._eval_bit_jit(cw, parents, direction, derived_bits)
     f_max = parent_idx.shape[0]
     alive = jnp.arange(f_max) < n_alive
     return Frontier(states=states, alive=alive)

@@ -487,14 +487,20 @@ class CollectorServer:
             # peer data plane — cancelling between its send and recv would
             # leave the peer's frame unread and desynchronize every later
             # exchange (the old sequential loop always finished the verb in
-            # flight; concurrent handling must keep that guarantee).  The
-            # timeout covers the one case draining can't: the verb is stuck
-            # on a DEAD peer — then the data plane is already lost and
-            # cancelling costs nothing.
-            if tasks:
-                done, pending = await asyncio.wait(tasks, timeout=120)
-                for t in pending:
-                    t.cancel()
+            # flight; concurrent handling must keep that guarantee), and a
+            # verb may legitimately run for minutes (first-call device
+            # compiles).  Cancel only on the one condition draining cannot
+            # cover: the PEER connection itself is gone — then the data
+            # plane is already lost and cancelling costs nothing.
+            pending = set(tasks)
+            while pending:
+                _, pending = await asyncio.wait(pending, timeout=30)
+                if pending and (
+                    self._peer_writer is None or self._peer_writer.is_closing()
+                ):
+                    for t in pending:
+                        t.cancel()
+                    break
             writer.close()
 
     async def start(self, host: str, port: int, peer_host: str, peer_port: int):

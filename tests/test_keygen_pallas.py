@@ -50,3 +50,27 @@ def test_pallas_engine_selectable(rng):
     w0, _ = ibdcf.gen_l_inf_ball(pts, 1, np.random.default_rng(42), engine="np")
     np.testing.assert_array_equal(np.asarray(k0.cw_seed), np.asarray(w0.cw_seed))
     np.testing.assert_array_equal(np.asarray(k0.cw_bits), np.asarray(w0.cw_bits))
+
+
+@pytest.mark.parametrize("derived", [False, True])
+def test_pallas_advance_bit_exact(rng, derived):
+    """The fused eval kernel (ops/eval_pallas.py) matches the XLA advance
+    step exactly — the crawl's hot path has one semantics."""
+    import jax.numpy as jnp
+
+    from fuzzyheavyhitters_tpu.ops import ibdcf
+    from fuzzyheavyhitters_tpu.protocol import collect
+
+    n, d, L, F = 300, 2, 8, 16
+    pts = rng.integers(0, 2, size=(n, d, L)).astype(bool)
+    k0, _ = ibdcf.gen_l_inf_ball(pts, 1, rng, engine="np")
+    f = collect.tree_init(k0, F)
+    parent = jnp.zeros(F, jnp.int32)
+    pat = jnp.asarray(rng.integers(0, 2, size=(F, d)).astype(bool))
+    a = collect._advance_jit(k0, f, 0, parent, pat, 4, derived, False)
+    b = collect._advance_jit(k0, f, 0, parent, pat, 4, derived, True)
+    for name in ("seed", "bit", "y_bit"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a.states, name)),
+            np.asarray(getattr(b.states, name)),
+        )
