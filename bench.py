@@ -10,7 +10,11 @@ throughput vs the reference's single-threaded AES-NI baseline
   bytes (the ibDCFbench.rs:55-70 sweep + bincode size column);
 - ``aggregate_clients_per_sec``: the SERVER hot loop — a full
   data_len=512 trusted-mode crawl (expand -> exchange -> count ->
-  threshold -> prune/advance per level) over N clients on one chip.
+  threshold -> prune/advance per level) over N clients on one chip;
+- ``secure_crawl``: the same loop with the REAL GC+OT data plane between
+  two in-process collector servers over localhost sockets (e2e, so a
+  lower bound through the remote-chip tunnel);
+- ``upload``: 100k-key pipelined control-plane ingest.
 
 HBM plan at N = 1M clients (north star: 1M clients < 10 s on v5e-8): the
 frontier state is ``EvalState[F, N, d, 2]`` = seeds u32[...,4] + 2 bool
@@ -198,6 +202,88 @@ def bench_crawl(ibdcf, driver, rng, n=8192, L=512, f_max=64):
     }
 
 
+
+async def _bring_up_pair(cfg, port):
+    """Two collector servers + leader-side clients in this process:
+    s1 first (it listens on the data plane at port+11), then s0 dials —
+    the reference's startup ordering (server.rs:344-354).  Returns
+    (leader, c0, c1) with both servers reset."""
+    import asyncio
+
+    from fuzzyheavyhitters_tpu.protocol import rpc
+    from fuzzyheavyhitters_tpu.protocol.leader_rpc import RpcLeader
+
+    s0 = rpc.CollectorServer(0, cfg)
+    s1 = rpc.CollectorServer(1, cfg)
+    t1 = asyncio.create_task(
+        s1.start("127.0.0.1", port + 10, "127.0.0.1", port + 11)
+    )
+    await asyncio.sleep(0.05)
+    t0 = asyncio.create_task(s0.start("127.0.0.1", port, "127.0.0.1", port + 11))
+    c0 = await rpc.CollectorClient.connect("127.0.0.1", port)
+    c1 = await rpc.CollectorClient.connect("127.0.0.1", port + 10)
+    await asyncio.gather(t0, t1)
+    lead = RpcLeader(cfg, c0, c1)
+    await asyncio.gather(c0.call("reset"), c1.call("reset"))
+    return lead, c0, c1
+
+
+def bench_secure(n=1024, L=12, port=39831):
+    """Secure-mode aggregate crawl: both collector servers in one process
+    with the REAL GC+OT data plane (secure_exchange=true), full level loop
+    over localhost sockets on the default device.  End-to-end wall time —
+    includes the per-level socket+tunnel round trips, so it is a lower
+    bound on what adjacent hardware achieves (ref seam: collect.rs:419-482
+    inside tree_crawl)."""
+    import asyncio
+    import contextlib
+    import io
+
+    from fuzzyheavyhitters_tpu.ops import ibdcf
+    from fuzzyheavyhitters_tpu.protocol import rpc
+    from fuzzyheavyhitters_tpu.protocol.leader_rpc import RpcLeader
+    from fuzzyheavyhitters_tpu.utils.config import Config
+
+    rng = np.random.default_rng(3)
+    sites = rng.integers(0, 1 << L, size=8)
+    pts = sites[rng.integers(0, 8, size=n)]
+    pts_bits = (
+        ((pts[:, None, None] >> np.arange(L - 1, -1, -1)) & 1) > 0
+    )  # [n, 1, L] MSB-first
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng, engine="pallas")
+
+    cfg = Config(
+        data_len=L, n_dims=1, ball_size=2, addkey_batch_size=1024,
+        num_sites=8, threshold=0.05, zipf_exponent=1.03,
+        server0=f"127.0.0.1:{port}", server1=f"127.0.0.1:{port + 10}",
+        distribution="zipf", f_max=64, secure_exchange=True,
+    )
+
+    async def run():
+        lead, c0, c1 = await _bring_up_pair(cfg, port)
+        await lead.upload_keys(k0, k1)
+        res = await lead.run(n)  # warm: compiles every secure program
+        assert res.paths.shape[0] >= 1
+        await asyncio.gather(c0.call("reset"), c1.call("reset"))
+        await lead.upload_keys(k0, k1)
+        t = time.perf_counter()
+        res = await lead.run(n)
+        dt = time.perf_counter() - t
+        return dt, int(res.paths.shape[0])
+
+    with contextlib.redirect_stdout(io.StringIO()):  # phase-timer prints
+        dt, hitters = asyncio.run(run())
+    return {
+        "secure_clients_per_sec": round(n / dt, 1),
+        "secure_crawl_seconds": round(dt, 3),
+        "n_clients": n,
+        "data_len": L,
+        "ms_per_level_e2e": round(dt / L * 1000, 2),
+        "hitters": hitters,
+        "gc_tests_per_level": cfg.f_max * 2 * n,
+    }
+
+
 def bench_upload(n=100_000, L=16, batch=1000, port=39731):
     """100k-key ingest benchmark: leader -> two servers over localhost TCP
     with the id'd pipelined framing (ref: leader.rs:340-364's 1000
@@ -224,20 +310,7 @@ def bench_upload(n=100_000, L=16, batch=1000, port=39731):
     )
 
     async def run():
-        s0 = rpc.CollectorServer(0, cfg)
-        s1 = rpc.CollectorServer(1, cfg)
-        t1 = asyncio.create_task(
-            s1.start("127.0.0.1", port + 10, "127.0.0.1", port + 11)
-        )
-        await asyncio.sleep(0.05)
-        t0 = asyncio.create_task(
-            s0.start("127.0.0.1", port, "127.0.0.1", port + 11)
-        )
-        c0 = await rpc.CollectorClient.connect("127.0.0.1", port)
-        c1 = await rpc.CollectorClient.connect("127.0.0.1", port + 10)
-        await asyncio.gather(t0, t1)
-        lead = RpcLeader(cfg, c0, c1)
-        await asyncio.gather(c0.call("reset"), c1.call("reset"))
+        lead, c0, c1 = await _bring_up_pair(cfg, port)
         t = time.perf_counter()
         await lead.upload_keys(k0, k1)
         return time.perf_counter() - t
@@ -296,6 +369,10 @@ def main():
     headline, sweep = bench_keygen(jax, jnp, ibdcf, rng)
     crawl = _crawl_subprocess()
     try:
+        secure = bench_secure()
+    except Exception as e:
+        secure = {"error": f"{type(e).__name__}: {e}"[:200]}
+    try:
         upload = bench_upload()
     except Exception as e:
         upload = {"error": f"{type(e).__name__}: {e}"[:200]}
@@ -311,6 +388,7 @@ def main():
                     "keygen_sweep": sweep,
                     "reference_key_bytes": BASELINE_KEY_BYTES,
                     "crawl": crawl,
+                    "secure_crawl": secure,
                     "upload": upload,
                 },
             }

@@ -493,11 +493,18 @@ class CollectorServer:
             # cover: the PEER connection itself is gone — then the data
             # plane is already lost and cancelling costs nothing.
             pending = set(tasks)
+            deadline = asyncio.get_event_loop().time() + 600
             while pending:
                 _, pending = await asyncio.wait(pending, timeout=30)
-                if pending and (
+                if not pending:
+                    break
+                peer_gone = (
                     self._peer_writer is None or self._peer_writer.is_closing()
-                ):
+                )
+                # the wall-clock backstop covers the peer dying SILENTLY
+                # (partition/power loss delivers no FIN/RST, so is_closing()
+                # never fires and a _swap recv would block forever)
+                if peer_gone or asyncio.get_event_loop().time() > deadline:
                     for t in pending:
                         t.cancel()
                     break
