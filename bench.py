@@ -328,20 +328,13 @@ def bench_upload(n=100_000, L=16, batch=1000, port=39731):
     }
 
 
-def _crawl_subprocess(timeout_s: int = 540):
-    """Run the crawl benchmark in a child process with a hard timeout so a
-    stalled accelerator tunnel can never take down the whole bench run
-    (the keygen headline must always print)."""
+def _subprocess_metric(code: str, timeout_s: int):
+    """Run one benchmark in a child process with a hard timeout so a
+    stalled accelerator tunnel (or a hung socket loop) can never take down
+    the whole bench run — the keygen headline must always print."""
     import subprocess
     import sys
 
-    code = (
-        "import json, numpy as np, bench;"
-        "from fuzzyheavyhitters_tpu.ops import ibdcf;"
-        "from fuzzyheavyhitters_tpu.protocol import driver;"
-        "print(json.dumps(bench.bench_crawl(ibdcf, driver,"
-        " np.random.default_rng(0))))"
-    )
     try:
         out = subprocess.run(
             [sys.executable, "-c", code],
@@ -367,11 +360,19 @@ def main():
 
     rng = np.random.default_rng(0)
     headline, sweep = bench_keygen(jax, jnp, ibdcf, rng)
-    crawl = _crawl_subprocess()
-    try:
-        secure = bench_secure()
-    except Exception as e:
-        secure = {"error": f"{type(e).__name__}: {e}"[:200]}
+    crawl = _subprocess_metric(
+        "import json, numpy as np, bench;"
+        "from fuzzyheavyhitters_tpu.ops import ibdcf;"
+        "from fuzzyheavyhitters_tpu.protocol import driver;"
+        "print(json.dumps(bench.bench_crawl(ibdcf, driver,"
+        " np.random.default_rng(0))))",
+        timeout_s=540,
+    )
+    secure = _subprocess_metric(
+        "import json, bench;"
+        "print(json.dumps(bench.bench_secure()))",
+        timeout_s=540,
+    )
     try:
         upload = bench_upload()
     except Exception as e:

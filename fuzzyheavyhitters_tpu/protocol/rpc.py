@@ -492,23 +492,40 @@ class CollectorServer:
             # compiles).  Cancel only on the one condition draining cannot
             # cover: the PEER connection itself is gone — then the data
             # plane is already lost and cancelling costs nothing.
+            # A silently-dead peer (partition/power loss, no FIN/RST) is
+            # surfaced by the data-plane socket's TCP keepalive (_keepalive,
+            # ~2 min): the blocked _swap recv then raises, the verb task
+            # finishes on its own, and is_closing() turns true — so this
+            # loop needs no wall-clock guess that could misfire on a LIVE
+            # peer running legitimately long verbs.
             pending = set(tasks)
-            deadline = asyncio.get_event_loop().time() + 600
             while pending:
                 _, pending = await asyncio.wait(pending, timeout=30)
-                if not pending:
-                    break
-                peer_gone = (
+                if pending and (
                     self._peer_writer is None or self._peer_writer.is_closing()
-                )
-                # the wall-clock backstop covers the peer dying SILENTLY
-                # (partition/power loss delivers no FIN/RST, so is_closing()
-                # never fires and a _swap recv would block forever)
-                if peer_gone or asyncio.get_event_loop().time() > deadline:
+                ):
                     for t in pending:
                         t.cancel()
                     break
             writer.close()
+
+    @staticmethod
+    def _keepalive(writer: asyncio.StreamWriter) -> None:
+        """Aggressive-ish TCP keepalive on the persistent data plane so a
+        SILENTLY dead peer (partition, power loss — no FIN/RST) surfaces as
+        a connection error within ~2 minutes instead of hanging a blocked
+        ``_swap`` recv forever (kernels default to ~2 hours)."""
+        import socket
+
+        sock = writer.get_extra_info("socket")
+        if sock is None:
+            return
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+        for opt, val in (
+            ("TCP_KEEPIDLE", 60), ("TCP_KEEPINTVL", 20), ("TCP_KEEPCNT", 3)
+        ):
+            if hasattr(socket, opt):
+                sock.setsockopt(socket.IPPROTO_TCP, getattr(socket, opt), val)
 
     async def start(self, host: str, port: int, peer_host: str, peer_port: int):
         """Bring up the data plane FIRST (like the reference: GC mesh before
@@ -529,12 +546,14 @@ class CollectorServer:
             else:
                 raise ConnectionError("peer data-plane unreachable")
             self._peer_reader, self._peer_writer = r, w
+            self._keepalive(w)
             await self._plane_handshake()
         self._rpc_srv = await asyncio.start_server(self._handle_leader, host, port)
         return self._rpc_srv
 
     async def _on_peer(self, reader, writer):
         self._peer_reader, self._peer_writer = reader, writer
+        self._keepalive(writer)
         await self._plane_handshake()
         self._peer_ready.set()
 
