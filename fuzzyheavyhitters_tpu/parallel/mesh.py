@@ -67,8 +67,43 @@ def field_psum(field, v, axis_name):
     return field.add(field.new(lo), field.mul(field.new(hi), field.from_int(1 << 32)))
 
 
+def init_distributed(
+    coordinator: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join a multi-host JAX runtime (the DCN scale-out entry point).
+
+    After this, ``jax.devices()`` is the GLOBAL device list,
+    :func:`make_mesh` accepts it, and the shard_mapped crawl programs
+    compile for the multi-host mesh with XLA routing each collective over
+    ICI within a slice and DCN across slices — the scale-out axis the
+    reference covers with tarpc + TCP socket meshes (SURVEY.md §2
+    "distributed communication backend").  Arguments default to JAX's
+    standard env/cluster autodetection (``jax.distributed.initialize``
+    semantics).
+
+    Scope, stated honestly: the host-side paths are single-process today —
+    ``MeshRunner.__init__`` device_puts full arrays (multi-process ingest
+    needs per-process local shards via
+    ``jax.make_array_from_process_local_data``), and ``_setup_secure``
+    draws per-process host randomness (multi-process secure mode needs the
+    session seeds / base-OT material agreed from process 0).  Those two
+    seams are the remaining multi-host work; the device programs
+    themselves need no changes.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
-    """2 × (n/2) mesh: first axis the two servers, rest data parallel."""
+    """2 × (n/2) mesh: first axis the two servers, rest data parallel.
+
+    ``devices`` may be local chips or (after :func:`init_distributed`) the
+    global multi-host device list."""
     if devices is None:
         devices = jax.devices()[: n_devices or len(jax.devices())]
     n = len(devices)
