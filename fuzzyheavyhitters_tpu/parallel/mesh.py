@@ -132,9 +132,11 @@ class MeshRunner:
         keys1: IbDcfKeyBatch,
         f_max: int,
         secure_exchange: bool = False,
+        min_bucket: int = 1,
     ):
         self.mesh = mesh
         self.f_max = f_max
+        self.min_bucket = min_bucket  # pin >1 only on compile-bound hosts
         self.secure = secure_exchange
         self.n_dims = keys0.cw_seed.shape[1]
         self.data_len = keys0.data_len
@@ -166,7 +168,14 @@ class MeshRunner:
             ),
             alive=P(SERVERS, None),
         )
+        # child-state cache [2, F, Nl, d, 2, 2(,4)]: party, node, client...
+        self._child_spec = EvalState(
+            seed=P(SERVERS, None, DATA),
+            bit=P(SERVERS, None, DATA),
+            y_bit=P(SERVERS, None, DATA),
+        )
         self.frontier: Frontier | None = None
+        self._children: EvalState | None = None
         self._masks = collect.pattern_masks(self.n_dims)
         self._kernel_cache: dict = {}
         self._build_kernels()
@@ -205,9 +214,13 @@ class MeshRunner:
         masks = jnp.asarray(self._masks)
         kspec, fspec = self._key_spec, self._frontier_spec
 
+        cspec = self._child_spec
+
+        root_bucket = self.min_bucket
+
         def init_body(keys):
             keys = jax.tree.map(lambda a: a[0], keys)  # drop party block axis
-            f = collect.tree_init(keys, f_max)
+            f = collect.tree_init(keys, root_bucket)
             return jax.tree.map(lambda a: a[None], f)
 
         self._init_fn = jax.jit(
@@ -218,7 +231,9 @@ class MeshRunner:
             keys = jax.tree.map(lambda a: a[0], keys)
             frontier = jax.tree.map(lambda a: a[0], frontier)
             alive = alive_keys[0]
-            packed = collect._expand_share_bits_jit(keys, frontier, level, derived)
+            packed, children = collect._expand_share_bits_jit(
+                keys, frontier, level, derived
+            )
             # one u32 per (node, client): the whole inter-party data plane
             peer = jax.lax.ppermute(packed, SERVERS, perm=[(0, 1), (1, 0)])
             cnt = collect.counts_by_pattern(packed, peer, masks, alive, frontier.alive)
@@ -226,30 +241,27 @@ class MeshRunner:
             # both parties compute identical counts (the compare is
             # symmetric); psum/2 over servers makes replication explicit
             cnt = jax.lax.psum(cnt, SERVERS) // 2
-            return cnt
+            return cnt, jax.tree.map(lambda a: a[None], children)
 
         self._counts_fn = jax.jit(
             jax.shard_map(
                 counts_body,
                 mesh=mesh,
                 in_specs=(kspec, fspec, P(SERVERS, DATA), P()),
-                out_specs=P(),
+                out_specs=(P(), cspec),
             )
         )
 
-        def adv_body(keys, frontier, level, parent, pat_bits, n_alive):
-            keys = jax.tree.map(lambda a: a[0], keys)
-            frontier = jax.tree.map(lambda a: a[0], frontier)
-            new = collect._advance_jit(
-                keys, frontier, level, parent, pat_bits, n_alive, derived
-            )
+        def advc_body(children, parent, pat_bits, n_alive):
+            ch = jax.tree.map(lambda a: a[0], children)
+            new = collect._advance_children_jit(ch, parent, pat_bits, n_alive)
             return jax.tree.map(lambda a: a[None], new)
 
         self._advance_fn = jax.jit(
             jax.shard_map(
-                adv_body,
+                advc_body,
                 mesh=mesh,
-                in_specs=(kspec, fspec, P(), P(None), P(None, None), P()),
+                in_specs=(cspec, P(None), P(None, None), P()),
                 out_specs=fspec,
             )
         )
@@ -296,7 +308,9 @@ class MeshRunner:
             gseed = gseed.at[2].set(gseed[2] ^ (shard << 16))
             bseed = bseed.at[2].set(bseed[2] ^ (shard << 16))
 
-            packed = collect._expand_share_bits_jit(keys_l, frontier_l, level, derived)
+            packed, children = collect._expand_share_bits_jit(
+                keys_l, frontier_l, level, derived
+            )
             strs = secure.child_strings(packed, d)  # [F, C, Nl, S]
             F_, C, Nl, S = strs.shape
             B = F_ * C * Nl
@@ -341,7 +355,7 @@ class MeshRunner:
                 field, vals.reshape((F_, C, Nl) + limb), wgt
             )
             shares = field_psum(field, shares, DATA)
-            return shares[None]
+            return shares[None], jax.tree.map(lambda a: a[None], children)
 
         out_spec = P(SERVERS, None, None, *([None] if limb else []))
         fn = jax.jit(
@@ -353,7 +367,7 @@ class MeshRunner:
                     P(SERVERS, None, None), P(SERVERS, None, None),
                     P(SERVERS, None), P(SERVERS, None), P(), P(), P(),
                 ),
-                out_specs=out_spec,
+                out_specs=(out_spec, self._child_spec),
             )
         )
         return fn
@@ -362,15 +376,16 @@ class MeshRunner:
 
     def tree_init(self):
         self.frontier = self._init_fn(self.keys)
+        self._children = None
 
     def level_counts(self, level: int) -> np.ndarray:
         """Crawl counts for every child of the current frontier: the
-        expand → exchange(ppermute) → compare → psum pipeline."""
-        return np.asarray(
-            self._counts_fn(
-                self.keys, self.frontier, self.alive_keys, jnp.int32(level)
-            )
+        expand → exchange(ppermute) → compare → psum pipeline.  The
+        both-direction child states are cached for :meth:`advance`."""
+        cnt, self._children = self._counts_fn(
+            self.keys, self.frontier, self.alive_keys, jnp.int32(level)
         )
+        return np.asarray(cnt)
 
     def level_count_shares(self, level: int, field=FE62) -> np.ndarray:
         """Secure crawl: both parties' additive count shares [2, F, 2^d
@@ -386,11 +401,13 @@ class MeshRunner:
         put = lambda a: jax.device_put(
             np.stack([a, z]), NamedSharding(self.mesh, P(SERVERS, None))
         )
-        # static per-call shapes -> deterministic stream consumption
+        # static per-call shapes -> deterministic stream consumption; the
+        # GC/OT batch is sized to the CURRENT frontier bucket, not f_max
         n_local = self.keys.cw_seed.shape[1] // self.mesh.shape[DATA]
-        B = self.f_max * (1 << self.n_dims) * n_local
+        f_cur = self.frontier.alive.shape[1]
+        B = f_cur * (1 << self.n_dims) * n_local
         m = B * 2 * self.n_dims
-        shares = fn(
+        shares, self._children = fn(
             self.keys, self.frontier, self.alive_keys,
             self._s_bits, self._seeds_main, self._seeds_aux,
             put(gseed), put(bseed),
@@ -404,22 +421,24 @@ class MeshRunner:
         return np.asarray(shares)
 
     def advance(self, level: int, parent_idx, pattern_bits, n_alive: int):
+        assert self._children is not None, "advance before level_counts"
         self.frontier = self._advance_fn(
-            self.keys,
-            self.frontier,
-            jnp.int32(level),
+            self._children,
             jnp.asarray(parent_idx, jnp.int32),
             jnp.asarray(pattern_bits, bool),
             jnp.int32(n_alive),
         )
+        self._children = None
 
 
 class MeshLeader:
     """Level-loop driver over a MeshRunner (host-side thresholds/paths,
     ref: leader.rs:185-297 — same bookkeeping as protocol.driver.Leader)."""
 
-    def __init__(self, runner: MeshRunner):
+    def __init__(self, runner: MeshRunner, min_bucket: int | None = None):
         self.r = runner
+        # default: the runner's own pin (so one knob covers init + prune)
+        self.min_bucket = runner.min_bucket if min_bucket is None else min_bucket
         self.paths = None
         self.n_nodes = 0
 
@@ -454,7 +473,9 @@ class MeshLeader:
             thresh = max(1, int(threshold * nreqs))
             keep = counts >= thresh
             keep[self.n_nodes :, :] = False
-            parent, pattern, n_alive = collect.compact_survivors(keep, r.f_max)
+            parent, pattern, n_alive = collect.compact_survivors(
+                keep, r.f_max, self.min_bucket
+            )
             pat_bits = collect.pattern_to_bits(pattern, d)
             if n_alive == 0:
                 return CrawlResult(

@@ -22,14 +22,30 @@ re-evaluates every client's FSS state once per child pattern
   ``all_bit_vectors`` child order, lib.rs:125-129), so the leader
   reconstructs paths from its own keep masks.
 
-Memory plan: the counts pass emits only packed share bits (4 B per
-node·client) — the expand → correction → pack pipeline is one fused XLA
-program, so child seeds never materialize in HBM; after the leader prunes,
-the surviving children's states come from one more expansion of their
-parents (``advance``).  Per level this is ``(F + F') × N × d × 2`` PRG
-expansions — still ``≈ 2^d / 2`` times fewer than the reference — and the
-peak HBM footprint is the parent frontier plus the packed-bit tensor,
-independent of ``2^d``.
+Work plan (round 4 — closing the padded-frontier waste the reference
+never has because it walks only live nodes, collect.rs:378-391):
+
+- **Bucketed frontier**: the padded node axis ``F`` is the smallest power
+  of two ≥ the survivor count (``bucket_for``), not a fixed ``f_max``.
+  Static shapes are preserved — each bucket size is its own compiled
+  program, at most ``log2(f_max)+1`` of them per crawl — while dead-slot
+  waste is bounded at 2× instead of ``f_max / n_alive`` (the round-3
+  regime ran 64 slots for ~4-8 live nodes: ~8-16× wasted PRG work).
+- **Child-state cache**: ``expand_share_bits`` already runs the PRG on
+  every (node, client, dim, side); it now also returns BOTH directions'
+  child :class:`EvalState`, so the post-prune ``advance_from_children``
+  is a pure gather+select — the second PRG pass of the old ``advance``
+  (another ``F' × N × d × 2`` expansions per level) is gone entirely.
+  Cost: the cache materializes ``F × N × d × 2 × 2`` child states
+  (~72 B per (node, client, dim, side)) in HBM between crawl and prune —
+  at bucketed ``F`` this is MBs, not GBs, and it replaces compute with
+  bandwidth the TPU has to spare.  ``advance`` (re-expand) remains for
+  callers without a cache.
+
+Per level the PRG cost is now ``F_bucket × N × d × 2`` expansions — one
+pass, sized to survivors — and the peak HBM footprint is the parent
+frontier plus the child cache plus the packed-bit tensor, independent of
+``2^d``.
 """
 
 from __future__ import annotations
@@ -46,35 +62,59 @@ from ..ops.ibdcf import EvalState, IbDcfKeyBatch
 
 MAX_DIMS = 8  # packed-u32 layout holds d*4 bits
 
-# Advance-step engine, read at TRACE time: True routes the per-level eval
-# expansion through the fused Pallas kernel (ops/eval_pallas.py).  Opt-in
-# and TPU-only (the mesh/shard_map path always uses XLA): measured
-# net-neutral at bench sizes through the remote-chip tunnel, kept for
-# locally-attached chips where dispatch overhead is not the floor.
+# Engine knob for the RE-EXPANDING fallback `advance` only, read at TRACE
+# time: True routes its eval expansion through the fused Pallas kernel
+# (ops/eval_pallas.py).  The crawl paths no longer take that code path at
+# all — `advance_from_children` replaced the second PRG pass with a gather
+# (strictly better than any kernel for it) — so this stays opt-in for the
+# fallback and for the kernel's own parity tests.
 EVAL_PALLAS = False
 
 
 class Frontier(NamedTuple):
-    """Per-server frontier state for ``F`` (padded) tree nodes.
+    """Per-server frontier state for ``F`` (bucket-padded) tree nodes.
 
     states: EvalState over ``[F, N, d, 2]`` (node, client, dim, left/right);
     alive:  bool[F] node-liveness mask (dead slots are padding).
+
+    ``F`` is the current *bucket* — the smallest power of two holding the
+    live nodes (see :func:`bucket_for`), not a global maximum.
     """
 
     states: EvalState
     alive: jax.Array
 
     @property
-    def f_max(self) -> int:
+    def f_bucket(self) -> int:
         return self.states.bit.shape[0]
 
 
-def tree_init(keys: IbDcfKeyBatch, f_max: int) -> Frontier:
+def bucket_for(n_alive: int, f_max: int, min_bucket: int = 1) -> int:
+    """Smallest power of two ≥ ``n_alive`` (≥ ``min_bucket``), capped by
+    ``f_max``.
+
+    Sizing frontier tensors to the bucket keeps shapes static per bucket
+    (a handful of compiles) while bounding dead-slot waste at 2× — the
+    tensor analogue of the reference expanding only live nodes
+    (collect.rs:378-391).  ``min_bucket`` lets compile-bound callers (the
+    1-core-CPU test host, where every bucket is an XLA compile) pin a
+    single shape; performance callers leave it at 1."""
+    if n_alive > f_max:
+        raise ValueError(
+            f"{n_alive} surviving nodes exceed f_max={f_max}; "
+            "raise f_max or the threshold"
+        )
+    b = 1 << max(0, int(np.ceil(np.log2(max(1, n_alive)))))
+    return min(f_max, max(b, min_bucket))
+
+
+def tree_init(keys: IbDcfKeyBatch, f_bucket: int = 1) -> Frontier:
     """Root frontier: one alive node whose states are eval_init of every
-    (client, dim, side) key (ref: collect.rs:67-92)."""
+    (client, dim, side) key (ref: collect.rs:67-92).  The root bucket is 1
+    slot; it grows with the survivor count (``bucket_for``)."""
     root = ibdcf.eval_init(keys)  # [N, d, 2]
-    pad = lambda a: jnp.broadcast_to(a[None], (f_max,) + a.shape)
-    alive = jnp.zeros((f_max,), bool).at[0].set(True)
+    pad = lambda a: jnp.broadcast_to(a[None], (f_bucket,) + a.shape)
+    alive = jnp.zeros((f_bucket,), bool).at[0].set(True)
     return Frontier(states=EvalState(*[pad(x) for x in root]), alive=alive)
 
 
@@ -102,37 +142,93 @@ def pattern_masks(d: int) -> np.ndarray:
     return np.array(masks, dtype=np.uint32)
 
 
-def expand_share_bits(keys: IbDcfKeyBatch, frontier: Frontier, level) -> jax.Array:
-    """One PRG expansion of the whole frontier -> packed share bits.
+def expand_share_bits(
+    keys: IbDcfKeyBatch, frontier: Frontier, level, want_children: bool = True
+) -> tuple[jax.Array, EvalState | None]:
+    """One PRG expansion of the whole frontier -> packed share bits + the
+    both-direction child-state cache.
 
-    Returns uint32[F, N]: for every (node, client), the share bits
-    ``y_bit ^ bit`` of BOTH child directions of every (dim, side) key,
-    packed at ``_bit_positions`` (the tensor twin of collect.rs:393-410's
-    per-(node,client) left||right bit strings — ours carries both
-    directions so all 2^d patterns read from it).
+    Returns ``(packed, children)``:
+
+    - packed uint32[F, N]: for every (node, client), the share bits
+      ``y_bit ^ bit`` of BOTH child directions of every (dim, side) key,
+      packed at ``_bit_positions`` (the tensor twin of collect.rs:393-410's
+      per-(node,client) left||right bit strings — ours carries both
+      directions so all 2^d patterns read from it);
+    - children: EvalState over ``[F, N, d, 2, 2]`` (trailing axis =
+      direction) — the fully-corrected child states of every slot, so the
+      post-prune :func:`advance_from_children` is a gather, not a second
+      PRG pass.
 
     ``level`` may be traced; the same value must hold for the whole frontier
     (the crawl is level-synchronous, ref: leader.rs:417-440).
+
+    ``want_children=False`` (the LAST level, which nothing advances past)
+    skips materializing the cache — jit outputs are never dead-code
+    eliminated, so the flag must be static, not a discarded return.
     """
-    return _expand_share_bits_jit(keys, frontier, level, prg.DERIVED_BITS)
+    return _expand_share_bits_jit(
+        keys, frontier, level, prg.DERIVED_BITS, want_children
+    )
 
 
-@partial(jax.jit, static_argnames=("derived_bits",))
-def _expand_share_bits_jit(keys, frontier, level, derived_bits):
+@partial(jax.jit, static_argnames=("derived_bits", "want_children"))
+def _expand_share_bits_jit(keys, frontier, level, derived_bits, want_children=True):
     cw_seed, cw_bits, cw_y = ibdcf.level_cw(keys, level)  # [N,d,2,(4|2)]
     st = frontier.states  # leaves [F, N, d, 2(,4)]
-    # one fully-batched expansion over (node, client, dim, side); XLA fuses
-    # expand -> correction -> pack, so child seeds never hit HBM
-    _, _, tau_b, tau_y = prg.expand(st.seed, derived_bits)  # [F,N,d,2,2]
+    # one fully-batched expansion over (node, client, dim, side)
+    s_l, s_r, tau_b, tau_y = prg.expand(st.seed, derived_bits)  # [F,N,d,2,(2|4)]
     t = st.bit[..., None]
     nb = jnp.where(t, tau_b ^ cw_bits, tau_b)  # cw broadcasts over F
     ny = jnp.where(t, tau_y ^ cw_y, tau_y)
     ny = ny ^ st.y_bit[..., None]
     share = nb ^ ny  # share bit = y ^ t per direction
     pos = jnp.asarray(_bit_positions(share.shape[-3]))  # [d, 2, 2]
-    return jnp.sum(
+    packed = jnp.sum(
         share.astype(jnp.uint32) << pos, axis=(-3, -2, -1), dtype=jnp.uint32
     )  # [F, N] uint32
+    if not want_children:
+        return packed, None
+    # child-state cache: direction axis second-to-last (matching nb/ny's
+    # trailing direction axis), seed correction applied per ibDCF.rs:213-218
+    seeds = jnp.stack([s_l, s_r], axis=-2)  # [F, N, d, 2, 2, 4]
+    tc = st.bit[..., None, None]  # [F, N, d, 2, 1, 1]
+    seeds = jnp.where(tc, seeds ^ cw_seed[..., None, :], seeds)
+    children = EvalState(seed=seeds, bit=nb, y_bit=ny)
+    return packed, children
+
+
+def advance_from_children(
+    children: EvalState,
+    parent_idx: jax.Array,
+    pattern_bits: jax.Array,
+    n_alive,
+) -> Frontier:
+    """Materialize the surviving children from the expand-time cache.
+
+    parent_idx:   int32[F'] parent slot per surviving child (bucket-padded);
+    pattern_bits: bool[F', d] child pattern per survivor;
+    n_alive:      number of real entries (rest is padding).
+
+    A gather over the node axis + a per-dim direction select — zero PRG
+    work (the expansion already happened in :func:`expand_share_bits`).
+    Both keys of a dim take the same direction bit: the interval pair
+    walks together (ref: collect.rs:100, ibDCF.rs:120-131).
+    """
+    return _advance_children_jit(children, parent_idx, pattern_bits, n_alive)
+
+
+@jax.jit
+def _advance_children_jit(children, parent_idx, pattern_bits, n_alive):
+    ch = jax.tree.map(lambda a: a[parent_idx], children)  # [F', N, d, 2, 2, ...]
+    dirb = pattern_bits[:, None, :, None]  # [F', 1, d, 1] -> broadcast [F', N, d, 2]
+    states = EvalState(
+        seed=jnp.where(dirb[..., None], ch.seed[..., 1, :], ch.seed[..., 0, :]),
+        bit=jnp.where(dirb, ch.bit[..., 1], ch.bit[..., 0]),
+        y_bit=jnp.where(dirb, ch.y_bit[..., 1], ch.y_bit[..., 0]),
+    )
+    alive = jnp.arange(parent_idx.shape[0]) < n_alive
+    return Frontier(states=states, alive=alive)
 
 
 @jax.jit
@@ -165,7 +261,9 @@ def advance(
     pattern_bits: jax.Array,
     n_alive: jax.Array,
 ) -> Frontier:
-    """Materialize the surviving children as the next frontier.
+    """Re-expanding advance: the fallback for callers WITHOUT a child-state
+    cache from :func:`expand_share_bits` (the crawl paths all have one and
+    use :func:`advance_from_children` instead — zero PRG work).
 
     parent_idx:   int32[F'] parent slot per surviving child (padded);
     pattern_bits: bool[F', d] child pattern per survivor;
@@ -227,18 +325,15 @@ def _advance_jit(keys, frontier, level, parent_idx, pattern_bits, n_alive,
 # ---------------------------------------------------------------------------
 
 
-def compact_survivors(keep: np.ndarray, f_max: int):
-    """keep: bool[F, 2^d] -> (parent_idx int32[f_max], pattern int32[f_max],
-    n_alive) padded with zeros.  Raises if survivors exceed f_max — the
-    padded-frontier equivalent of the reference's unbounded Vec growth."""
+def compact_survivors(keep: np.ndarray, f_max: int, min_bucket: int = 1):
+    """keep: bool[F, 2^d] -> (parent_idx int32[Fb], pattern int32[Fb],
+    n_alive) zero-padded to the survivor bucket ``Fb = bucket_for(...)``.
+    Raises if survivors exceed the ``f_max`` cap — the bucketed-frontier
+    equivalent of the reference's unbounded Vec growth."""
     f, c = np.nonzero(keep)
-    if len(f) > f_max:
-        raise ValueError(
-            f"{len(f)} surviving nodes exceed f_max={f_max}; "
-            "raise f_max (recompiles) or the threshold"
-        )
-    parent = np.zeros(f_max, np.int32)
-    pattern = np.zeros(f_max, np.int32)
+    fb = bucket_for(len(f), f_max, min_bucket)
+    parent = np.zeros(fb, np.int32)
+    pattern = np.zeros(fb, np.int32)
     parent[: len(f)] = f
     pattern[: len(f)] = c
     return parent, pattern, len(f)

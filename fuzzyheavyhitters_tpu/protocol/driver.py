@@ -35,6 +35,7 @@ class ServerState:
     keys: IbDcfKeyBatch  # [N, d, 2]
     alive_keys: np.ndarray  # bool[N] liveness flags (ref: collect.rs:32)
     frontier: collect.Frontier | None = None
+    children: object | None = None  # expand-time child-state cache
 
 
 @dataclass
@@ -58,13 +59,15 @@ class Leader:
     n_dims: int
     data_len: int
     f_max: int = 256
+    min_bucket: int = 1  # pin >1 only on compile-bound test hosts
     # leader-side bookkeeping
     paths: np.ndarray = field(default=None)  # bool[F, d, level]
     n_nodes: int = 0
 
     def tree_init(self):
         for s in (self.server0, self.server1):
-            s.frontier = collect.tree_init(s.keys, self.f_max)
+            s.frontier = collect.tree_init(s.keys, self.min_bucket)
+            s.children = None
         self.paths = np.zeros((1, self.n_dims, 0), bool)
         self.n_nodes = 1
 
@@ -76,8 +79,13 @@ class Leader:
         """
         d = self.n_dims
         masks = collect.pattern_masks(d)
-        p0 = collect.expand_share_bits(self.server0.keys, self.server0.frontier, level)
-        p1 = collect.expand_share_bits(self.server1.keys, self.server1.frontier, level)
+        p0, ch0 = collect.expand_share_bits(
+            self.server0.keys, self.server0.frontier, level
+        )
+        p1, ch1 = collect.expand_share_bits(
+            self.server1.keys, self.server1.frontier, level
+        )
+        self.server0.children, self.server1.children = ch0, ch1
         counts = collect.counts_by_pattern(
             p0,
             p1,
@@ -90,13 +98,16 @@ class Leader:
         thresh = max(1, int(threshold * nreqs))  # ref: leader.rs:193-194
         keep = counts >= thresh  # [F, 2^d]
         keep[self.n_nodes :, :] = False
-        parent, pattern, n_alive = collect.compact_survivors(keep, self.f_max)
+        parent, pattern, n_alive = collect.compact_survivors(
+            keep, self.f_max, self.min_bucket
+        )
         pat_bits = collect.pattern_to_bits(pattern, d)
 
         for s in (self.server0, self.server1):
-            s.frontier = collect.advance(
-                s.keys, s.frontier, level, parent, pat_bits, n_alive
+            s.frontier = collect.advance_from_children(
+                s.children, parent, pat_bits, n_alive
             )
+            s.children = None
 
         # leader-side path bookkeeping (child bit j = (pattern >> j) & 1)
         new_paths = np.zeros((n_alive, d, self.paths.shape[-1] + 1), bool)

@@ -27,9 +27,16 @@ def _key_chunk(keys: IbDcfKeyBatch, sl: slice):
 
 
 class RpcLeader:
-    def __init__(self, cfg: Config, client0: CollectorClient, client1: CollectorClient):
+    def __init__(
+        self,
+        cfg: Config,
+        client0: CollectorClient,
+        client1: CollectorClient,
+        min_bucket: int = 1,
+    ):
         self.cfg = cfg
         self.c0, self.c1 = client0, client1
+        self.min_bucket = min_bucket  # pin >1 only on compile-bound hosts
         self.paths: np.ndarray | None = None
         self.n_nodes = 0
         self.has_sketch = False
@@ -79,7 +86,7 @@ class RpcLeader:
     async def run(self, nreqs: int) -> CrawlResult:
         cfg = self.cfg
         d, L = cfg.n_dims, cfg.data_len
-        await self._both("tree_init")
+        await self._both("tree_init", {"root_bucket": self.min_bucket})
         self.paths = np.zeros((1, d, 0), bool)
         self.n_nodes = 1
         thresh = max(1, int(cfg.threshold * nreqs))
@@ -104,7 +111,9 @@ class RpcLeader:
                 counts = np.asarray(FE62.canon(FE62.sub(s0, s1))).astype(np.uint32)
             keep = counts >= thresh
             keep[self.n_nodes :, :] = False
-            parent, pattern, n_alive = collect.compact_survivors(keep, cfg.f_max)
+            parent, pattern, n_alive = collect.compact_survivors(
+                keep, cfg.f_max, self.min_bucket
+            )
             pat_bits = collect.pattern_to_bits(pattern, d)
             if n_alive == 0:
                 return CrawlResult(

@@ -112,6 +112,7 @@ class CollectorServer:
     keys: IbDcfKeyBatch | None = None
     alive_keys: np.ndarray | None = None
     frontier: collect.Frontier | None = None
+    _children: object | None = None  # expand-time child-state cache
     _peer_reader: asyncio.StreamReader | None = None
     _peer_writer: asyncio.StreamWriter | None = None
     _ot: object | None = None  # OT-extension endpoint (secure_exchange)
@@ -124,6 +125,7 @@ class CollectorServer:
     _sketch_pairs: tuple | None = None  # (pair shares [F, N, lanes], depth)
     _sketch_pairs_field: object | None = None
     _sketch_seed: np.ndarray | None = None  # coin-flipped challenge seed
+    _gc_tests: int = 0  # secure-mode equality tests run since reset
     _verb_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
 
     # -- verbs (ref: rpc.rs:56-66) ---------------------------------------
@@ -133,12 +135,14 @@ class CollectorServer:
         self.keys = None
         self.alive_keys = None
         self.frontier = None
+        self._children = None
         self._last_shares = None
         self._sketch_parts.clear()
         self._sketch = None
         self._sketch_states = None
         self._sketch_pairs = None
         self._sketch_pairs_field = None
+        self._gc_tests = 0
         if self._ot is not None:  # fresh GC/b2a randomness per collection
             self._sec_seed = np.frombuffer(
                 _secrets.token_bytes(16), dtype="<u4"
@@ -159,9 +163,10 @@ class CollectorServer:
             )
         return True
 
-    async def tree_init(self, _req) -> bool:
+    async def tree_init(self, req) -> bool:
         if not self.keys_parts:
             raise RuntimeError("tree_init before add_keys")
+        root_bucket = int((req or {}).get("root_bucket", 1))
         self.keys = IbDcfKeyBatch(
             *[
                 np.concatenate([np.asarray(p[i]) for p in self.keys_parts])
@@ -170,7 +175,8 @@ class CollectorServer:
         )
         n = self.keys.cw_seed.shape[0]
         self.alive_keys = np.ones(n, bool)
-        self.frontier = collect.tree_init(self.keys, self.cfg.f_max)
+        self.frontier = collect.tree_init(self.keys, root_bucket)
+        self._children = None
         if self._sketch_parts:
             leaves = [jax.tree.leaves(p) for p in self._sketch_parts]
             cat = [np.concatenate([np.asarray(p[i]) for p in leaves])
@@ -182,10 +188,7 @@ class CollectorServer:
                 raise RuntimeError("sketch verification covers n_dims=1")
             root = dpf.eval_init(self._sketch.key)  # [N]
             self._sketch_states = jax.tree.map(
-                lambda a: jnp.broadcast_to(
-                    a[None], (self.cfg.f_max,) + a.shape
-                ),
-                root,
+                lambda a: jnp.broadcast_to(a[None], (1,) + a.shape), root
             )
             self._sketch_pairs = None
         return True
@@ -214,7 +217,7 @@ class CollectorServer:
         last = self._sketch_pairs_field is F255
         fld = self._sketch_pairs_field
         n = self.alive_keys.shape[0]
-        f_max = self.cfg.f_max
+        f_bucket = pairs_fn.shape[0]  # stored shares' node bucket
         bs = max(
             1,
             self.cfg.sketch_batch_size_last if last else self.cfg.sketch_batch_size,
@@ -225,7 +228,7 @@ class CollectorServer:
             ks = jax.tree.map(lambda a: a[sl], self._sketch)
             n_sl = ok[sl].shape[0]
             r, rands = sketchmod.shared_r_stream(
-                fld, self._sketch_seed, level, f_max, n_sl
+                fld, self._sketch_seed, level, f_bucket, n_sl
             )
             pairs = pairs_fn[:, sl]  # [F, n_sl, lanes(, limbs)]
             pairs = jnp.moveaxis(jnp.asarray(pairs), 0, 1)  # [n_sl, F, ...]
@@ -265,7 +268,7 @@ class CollectorServer:
         new_st, pair = dpf.eval_bit(
             cw, st, direction, cwv, k.key_idx[None], fld, sketchmod.LANES
         )
-        alive = (np.arange(self.cfg.f_max) < n_alive)[:, None, None]
+        alive = (np.arange(parent.shape[0]) < n_alive)[:, None, None]
         if fld.limb_shape:
             alive = alive[..., None]
         pair = jnp.where(jnp.asarray(alive), pair, 0)
@@ -284,9 +287,11 @@ class CollectorServer:
         await _send(self._peer_writer, obj)
         return peer
 
-    async def _crawl_counts(self, level: int) -> np.ndarray:
+    async def _crawl_counts(self, level: int, last: bool = False) -> np.ndarray:
         t0 = time.perf_counter()
-        packed = collect.expand_share_bits(self.keys, self.frontier, level)
+        packed, self._children = collect.expand_share_bits(
+            self.keys, self.frontier, level, want_children=not last
+        )
         packed_np = np.asarray(packed)  # forces the device work to finish
         t1 = time.perf_counter()
         # data plane: swap packed share bits with the peer server
@@ -305,18 +310,23 @@ class CollectorServer:
         print(f"Field actions - {t3 - t2:.4f}s")
         return counts
 
-    async def _crawl_counts_secure(self, level: int, count_field) -> np.ndarray:
+    async def _crawl_counts_secure(
+        self, level: int, count_field, last: bool = False
+    ) -> np.ndarray:
         """The real 2PC data plane (ref: collect.rs:419-501): GC equality +
         OT b2a over the peer socket; returns this server's additive field
         share of every per-(node, pattern) count.  No packed share-bit
         tensor ever crosses the server boundary in this mode."""
         t0 = time.perf_counter()
-        packed = collect.expand_share_bits(self.keys, self.frontier, level)
+        packed, self._children = collect.expand_share_bits(
+            self.keys, self.frontier, level, want_children=not last
+        )
         d = self.keys.cw_seed.shape[1]
         C, S = 1 << d, 2 * d
         strs = secure.child_strings(packed, d)  # [F, C, N, S]
         F_, _, N, _ = strs.shape
         B = F_ * C * N
+        self._gc_tests += B
         flat = strs.reshape(B, S)
         jax.block_until_ready(flat)
         t1 = time.perf_counter()
@@ -377,9 +387,9 @@ class CollectorServer:
         mode).  Shares are retained for final_shares re-serving."""
         level = req["level"]
         if self.cfg.secure_exchange:
-            shares = await self._crawl_counts_secure(level, F255)
+            shares = await self._crawl_counts_secure(level, F255, last=True)
         else:
-            counts = await self._crawl_counts(level)
+            counts = await self._crawl_counts(level, last=True)
             r = mask_f255(level, counts.size).reshape(counts.shape + (8,))
             if self.server_id == 0:
                 c = np.zeros(counts.shape + (8,), np.uint32)
@@ -398,9 +408,15 @@ class CollectorServer:
         parent = np.asarray(req["parent_idx"], np.int32)
         pat_bits = np.asarray(req["pattern_bits"], bool)
         n_alive = int(req["n_alive"])
-        self.frontier = collect.advance(
-            self.keys, self.frontier, level, parent, pat_bits, n_alive
-        )
+        if self._children is not None:  # cache from this level's crawl
+            self.frontier = collect.advance_from_children(
+                self._children, parent, pat_bits, n_alive
+            )
+            self._children = None
+        else:  # prune without a preceding crawl: re-expand
+            self.frontier = collect.advance(
+                self.keys, self.frontier, level, parent, pat_bits, n_alive
+            )
         if self._sketch is not None:
             self._advance_sketch(int(level), parent, pat_bits, n_alive)
         return True
@@ -412,6 +428,7 @@ class CollectorServer:
         so its F255 leaf payloads can be verified post-prune."""
         if self._last_shares is None:  # protocol-boundary check: no assert
             raise RuntimeError("tree_prune_last called before tree_crawl_last")
+        self._children = None  # leaf level: nothing advances past it
         parent = np.asarray(req["parent_idx"], np.int64)
         pattern = np.asarray(req["pattern_bits"], bool)
         n_alive = int(req["n_alive"])

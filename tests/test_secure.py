@@ -172,10 +172,10 @@ def test_secure_socket_run_matches_trusted(rng, monkeypatch):
         sent.append(obj)
         await real_send(writer, obj)
 
-    def spy_expand(keys, frontier, level):
-        out = real_expand(keys, frontier, level)
-        packed_tensors.append(np.asarray(out))
-        return out
+    def spy_expand(keys, frontier, level, **kw):
+        packed, children = real_expand(keys, frontier, level, **kw)
+        packed_tensors.append(np.asarray(packed))
+        return packed, children
 
     monkeypatch.setattr(rpc, "_send", spy_send)
     monkeypatch.setattr(collect, "expand_share_bits", spy_expand)
