@@ -123,23 +123,32 @@ def bench_keygen(jax, jnp, ibdcf, rng, sweep=(64, 256, 512, 1024), n=8192):
     return headline, rows
 
 
-def bench_crawl(ibdcf, driver, rng, n=8192, L=512, f_max=64):
+def bench_crawl(ibdcf, driver, rng, n=131072, L=512, f_max=64):
     """Server hot loop: full L-level trusted-mode crawl on one chip.
 
     Zipf-like scenario: clients cluster on a handful of sites so the
     frontier stays small (the production regime) while every level still
-    expands/compares all N clients.  The frontier is BUCKETED (round 4,
-    collect.bucket_for): work per level is sized to survivors, so the
-    steady-state bucket (~8 here) does 1/8th of round 3's f_max=64 padded
-    work, and advance is a gather from the expand-time child cache instead
-    of a second PRG pass."""
+    expands/compares all N clients.  Round-4 shape of the measurement:
+
+    - the frontier is BUCKETED (collect.bucket_for) and advance is a
+      gather from the expand-time child cache — per-level work is sized
+      to survivors, with no second PRG pass;
+    - N = 131072 so per-level COMPUTE dominates the tunnel's per-dispatch
+      floor (~2 ms/launch; at the old N=8192 that floor was most of the
+      measured "device" time, silently inflating the 1M projection 16x
+      more than compute justifies);
+    - the level pipeline is ONE jitted program (both servers' expand +
+      counts + both advances), matching the production mesh path where
+      counts_body is a single XLA dispatch per level (parallel/mesh.py).
+    """
     n_sites = 4
     sites = rng.integers(0, 2, size=(n_sites, 1, L)).astype(bool)
     pts_bits = sites[rng.integers(0, n_sites, size=n)]
-    # keygen on the chip (the fused kernel): host NumPy keygen for 8192
-    # 512-bit interval pairs takes minutes on a 1-core host
+    # keygen on the chip (the fused kernel): host NumPy keygen for 512-bit
+    # interval pairs at this N takes hours on a 1-core host
     k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng, engine="pallas")
 
+    import jax
     import jax.numpy as jnp
 
     from fuzzyheavyhitters_tpu.protocol import collect
@@ -157,8 +166,8 @@ def bench_crawl(ibdcf, driver, rng, n=8192, L=512, f_max=64):
         return time.perf_counter() - t0, n_alive, s0, s1
 
     # warm: a full slice visits every bucket size of the steady crawl
-    # (1 -> 2 -> 4 -> 8 ... as the sites' prefixes separate), compiling
-    # each shape once; the second, timed, slice replays the same buckets
+    # (1 -> 2 -> 4 ... as the sites' prefixes separate), compiling each
+    # shape once; the second, timed, slice replays the same buckets
     run_slice(timed_levels)
     dt_slice, n_alive, s0, s1 = run_slice(timed_levels)
     # by level 64 the 4 random sites' prefixes are distinct w.h.p., and
@@ -167,27 +176,32 @@ def bench_crawl(ibdcf, driver, rng, n=8192, L=512, f_max=64):
     f_bucket = s0.frontier.f_bucket
 
     # device-only level pipeline on the steady-state frontier the slice
-    # left behind (idempotent: same inputs each launch): 2x expand(+cache)
-    # + counts + 2x gather-advance — the per-server work is half of this
+    # left behind (idempotent: same inputs each launch); ONE fused program
+    # covering BOTH servers — the per-server cost is half of this
     masks = jnp.asarray(collect.pattern_masks(1))
     alive = jnp.asarray(s0.alive_keys)
     nb = collect.bucket_for(n_alive, f_max)
     parent = jnp.zeros(nb, jnp.int32)
     pat = jnp.zeros((nb, 1), bool)
 
-    def one_level(lvl):
-        p0, ch0 = collect.expand_share_bits(s0.keys, s0.frontier, lvl)
-        p1, ch1 = collect.expand_share_bits(s1.keys, s1.frontier, lvl)
-        cnt = collect.counts_by_pattern(p0, p1, masks, alive, s0.frontier.alive)
-        f0 = collect.advance_from_children(ch0, parent, pat, n_alive)
-        f1 = collect.advance_from_children(ch1, parent, pat, n_alive)
-        return cnt, f0, f1
+    @jax.jit
+    def one_level(keys0, f0, keys1, f1, lvl):
+        p0, ch0 = collect.expand_share_bits(keys0, f0, lvl)
+        p1, ch1 = collect.expand_share_bits(keys1, f1, lvl)
+        cnt = collect.counts_by_pattern(p0, p1, masks, alive, f0.alive)
+        nf0 = collect.advance_from_children(ch0, parent, pat, n_alive)
+        nf1 = collect.advance_from_children(ch1, parent, pat, n_alive)
+        return cnt, nf0, nf1
 
+    # 64 queued launches per sync: the tunnel's end-of-batch fetch costs a
+    # full round trip (~150 ms) — at 16 launches that RTT was ~10 ms/level
+    # of pure measurement artifact
     best = _steady_state_seconds(
-        lambda: one_level(timed_levels),
+        lambda: one_level(s0.keys, s0.frontier, s1.keys, s1.frontier,
+                          timed_levels),
         lambda outs: int(sum(jnp.sum(c[0, 0]) for c, _, _ in outs)),
         lambda o: int(jnp.sum(o[0])),
-        iters=16,
+        iters=64,
     )
     dt = best * L
     return {

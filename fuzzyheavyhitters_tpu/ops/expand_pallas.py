@@ -1,0 +1,118 @@
+"""Fused Pallas frontier expansion — the crawl's dominant chip op.
+
+``expand_share_bits`` (protocol/collect.py) is one ChaCha expansion per
+(node, client, dim, side) state emitting BOTH children (the batched twin
+of the reference's per-node re-evaluation loop, ref: collect.rs:378-410,
+ibDCF.rs:208-227).  With the frontier bucketed and advance turned into a
+gather, this expansion IS the level, so it gets the keygen kernel's
+layout family (ops/keygen_pallas.py: state index spread over (row,
+sublane, lane), cipher words as [R_BLK, 8, LANES] vregs).
+
+Round-4 measured status (v5e, B = 1M states): the kernel body beats the
+XLA level (~5 ms vs ~16 ms) but the word-planar glue — [B, 4] seed
+transposes in and two child-seed transposes out — costs ~25 ms, so the
+end-to-end call LOSES to XLA (~37 ms) and ``collect.EXPAND_PALLAS``
+defaults False.  The glue-free variant (slice the minor seed axis
+in-kernel) hangs the Mosaic compiler.  Flipping the default requires
+keeping frontier seeds word-planar across the crawl; kept in-tree,
+bit-exact and parity-tested, as that fast path's kernel.
+
+Scope: a pure flat map over B states — the caller keeps the correction-
+word broadcast over nodes, reshapes, and the share-bit packing in XLA
+(bandwidth-trivial next to the cipher).  Emits both-direction child
+seeds (t-corrected), t-bits, and y-bits: exactly the child-state cache +
+share-bit inputs of collect._expand_share_bits_jit, bit-exact in both
+PRG bit modes (tests/test_expand_pallas.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .keygen_pallas import LANES, SUB, _chacha16
+
+# row-groups per grid step.  Small on purpose: this kernel's blocks are
+# output-heavy (two child-seed planes), and at R_BLK=32 a block's footprint
+# (~13 MB) fills VMEM, serializing DMA against compute — measured 11 ms vs
+# 5 ms at R_BLK=4 for the same 1M-state batch.
+R_BLK = 4
+
+
+def _kernel(derived_bits: bool,
+            seed_ref, t_ref, y_ref, cws_ref, cwbl_ref, cwbr_ref,
+            cwyl_ref, cwyr_ref,
+            osl_ref, osr_ref, obl_ref, obr_ref, oyl_ref, oyr_ref):
+    """One row block, all u32 (flags as 0/1 words, selects as XOR-masks;
+    Mosaic rejects vector i1).  seed/cw_seed u32[4, R_BLK, 8, LANES],
+    everything else u32[R_BLK, 8, LANES]."""
+    t = t_ref[...]
+    tm = jnp.uint32(0) - t
+    blk = [seed_ref[w] for w in range(4)]
+    blk[0] = blk[0] & jnp.uint32(0xFFFFFFF0)  # prg.rs:97 mask
+    out = _chacha16(blk)
+    for w in range(4):  # both children, t-gated seed correction
+        osl_ref[w] = out[w] ^ (tm & cws_ref[w])
+        osr_ref[w] = out[4 + w] ^ (tm & cws_ref[w])
+    if derived_bits:
+        w8 = out[8]
+        b_l, b_r = (w8 & 1) ^ 1, ((w8 >> 1) & 1) ^ 1
+        y_l, y_r = ((w8 >> 2) & 1) ^ 1, ((w8 >> 3) & 1) ^ 1
+    else:  # the reference's masked-byte constants (prg.rs:103-104)
+        b_l = b_r = y_l = y_r = jnp.full(t.shape, 1, jnp.uint32)
+    y = y_ref[...]
+    obl_ref[...] = b_l ^ (t & cwbl_ref[...])
+    obr_ref[...] = b_r ^ (t & cwbr_ref[...])
+    oyl_ref[...] = y_l ^ (t & cwyl_ref[...]) ^ y
+    oyr_ref[...] = y_r ^ (t & cwyr_ref[...]) ^ y
+
+
+@partial(jax.jit, static_argnames=("derived_bits",))
+def expand_flat(seed, t, y, cw_seed, cwb_l, cwb_r, cwy_l, cwy_r,
+                derived_bits: bool):
+    """Expand B flat states into both children.
+
+    seed/cw_seed: u32[B, 4]; t, y, cwb_l/r, cwy_l/r: bool[B].
+    Returns (seed_l, seed_r u32[B, 4], bit_l, bit_r, y_l, y_r bool[B]) —
+    the per-direction outputs of collect's expand recurrence (child seed
+    already t-corrected, y accumulated along the path).
+    """
+    from jax.experimental import pallas as pl
+
+    B = seed.shape[0]
+    group = SUB * LANES
+    pad = (-B) % (group * R_BLK)
+    bp = B + pad
+    rows = bp // group
+
+    def flags(a):
+        a = jnp.asarray(a, jnp.uint32)
+        if pad:
+            a = jnp.concatenate([a, jnp.zeros((pad,), jnp.uint32)])
+        return a.reshape(rows, SUB, LANES)
+
+    def words(a):
+        a = jnp.asarray(a, jnp.uint32)
+        if pad:
+            a = jnp.concatenate([a, jnp.zeros((pad, 4), jnp.uint32)])
+        return jnp.transpose(a.reshape(rows, SUB, LANES, 4), (3, 0, 1, 2))
+
+    z = np.int32(0)
+    spec4 = pl.BlockSpec((4, R_BLK, SUB, LANES), lambda j: (z, j, z, z))
+    spec1 = pl.BlockSpec((R_BLK, SUB, LANES), lambda j: (j, z, z))
+    s4 = jax.ShapeDtypeStruct((4, rows, SUB, LANES), jnp.uint32)
+    s1 = jax.ShapeDtypeStruct((rows, SUB, LANES), jnp.uint32)
+    sl, sr, bl, br, yl, yr = pl.pallas_call(
+        partial(_kernel, derived_bits),
+        grid=(rows // R_BLK,),
+        in_specs=[spec4, spec1, spec1, spec4, spec1, spec1, spec1, spec1],
+        out_specs=[spec4, spec4, spec1, spec1, spec1, spec1],
+        out_shape=[s4, s4, s1, s1, s1, s1],
+    )(words(seed), flags(t), flags(y), words(cw_seed),
+      flags(cwb_l), flags(cwb_r), flags(cwy_l), flags(cwy_r))
+    unw = lambda a: jnp.transpose(a, (1, 2, 3, 0)).reshape(bp, 4)[:B]
+    unf = lambda a: a.reshape(bp)[:B] != 0
+    return unw(sl), unw(sr), unf(bl), unf(br), unf(yl), unf(yr)

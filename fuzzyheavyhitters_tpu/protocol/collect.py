@@ -70,6 +70,23 @@ MAX_DIMS = 8  # packed-u32 layout holds d*4 bits
 # fallback and for the kernel's own parity tests.
 EVAL_PALLAS = False
 
+# Engine for the level expansion itself (the crawl's dominant op): True
+# routes it through the fused Pallas kernel (ops/expand_pallas.py),
+# bit-exact vs the XLA form.  OFF by default, with the round-4 numbers
+# recorded honestly: at the bench shape (B=1M states) the kernel body
+# itself wins (~5 ms vs ~16 ms for the whole XLA level), but the
+# word-planar layout glue it needs — [B,4] <-> [4,rows,8,128] transposes
+# on seeds in and both child seeds out — costs more than the win
+# (~37 ms end to end), and Mosaic hangs compiling the glue-free variant
+# that slices the minor seed axis in-kernel.  The known path to flipping
+# this default is keeping frontier seeds WORD-PLANAR across the whole
+# crawl so the glue disappears; until then XLA is the faster engine.
+EXPAND_PALLAS: bool = False
+
+
+def _expand_engine() -> bool:
+    return EXPAND_PALLAS and jax.default_backend() != "cpu"
+
 
 class Frontier(NamedTuple):
     """Per-server frontier state for ``F`` (bucket-padded) tree nodes.
@@ -168,20 +185,45 @@ def expand_share_bits(
     eliminated, so the flag must be static, not a discarded return.
     """
     return _expand_share_bits_jit(
-        keys, frontier, level, prg.DERIVED_BITS, want_children
+        keys, frontier, level, prg.DERIVED_BITS, want_children,
+        _expand_engine(),
     )
 
 
-@partial(jax.jit, static_argnames=("derived_bits", "want_children"))
-def _expand_share_bits_jit(keys, frontier, level, derived_bits, want_children=True):
+@partial(jax.jit, static_argnames=("derived_bits", "want_children", "use_pallas"))
+def _expand_share_bits_jit(keys, frontier, level, derived_bits,
+                           want_children=True, use_pallas=False):
     cw_seed, cw_bits, cw_y = ibdcf.level_cw(keys, level)  # [N,d,2,(4|2)]
     st = frontier.states  # leaves [F, N, d, 2(,4)]
-    # one fully-batched expansion over (node, client, dim, side)
-    s_l, s_r, tau_b, tau_y = prg.expand(st.seed, derived_bits)  # [F,N,d,2,(2|4)]
-    t = st.bit[..., None]
-    nb = jnp.where(t, tau_b ^ cw_bits, tau_b)  # cw broadcasts over F
-    ny = jnp.where(t, tau_y ^ cw_y, tau_y)
-    ny = ny ^ st.y_bit[..., None]
+    shp = st.bit.shape  # [F, N, d, 2]
+    if use_pallas:
+        # fused kernel over the flat state axis; the cw broadcast over
+        # nodes and the reshapes stay in XLA (bandwidth-trivial)
+        from ..ops import expand_pallas
+
+        F = shp[0]
+        B = int(np.prod(shp))
+        def bflat(a):  # [N, d, 2, ...] -> broadcast over F -> [B, ...]
+            b = jnp.broadcast_to(a[None], (F,) + a.shape)
+            return b.reshape((B,) + b.shape[4:])
+        sl, sr, bl, br, yl, yr = expand_pallas.expand_flat(
+            st.seed.reshape(B, 4), st.bit.reshape(B), st.y_bit.reshape(B),
+            bflat(cw_seed),
+            bflat(cw_bits[..., 0]), bflat(cw_bits[..., 1]),
+            bflat(cw_y[..., 0]), bflat(cw_y[..., 1]),
+            derived_bits,
+        )
+        nb = jnp.stack([bl, br], axis=-1).reshape(shp + (2,))
+        ny = jnp.stack([yl, yr], axis=-1).reshape(shp + (2,))
+        seeds = jnp.stack([sl, sr], axis=-2).reshape(shp + (2, 4))
+    else:
+        # one fully-batched XLA expansion over (node, client, dim, side)
+        s_l, s_r, tau_b, tau_y = prg.expand(st.seed, derived_bits)
+        t = st.bit[..., None]
+        nb = jnp.where(t, tau_b ^ cw_bits, tau_b)  # cw broadcasts over F
+        ny = jnp.where(t, tau_y ^ cw_y, tau_y)
+        ny = ny ^ st.y_bit[..., None]
+        seeds = None
     share = nb ^ ny  # share bit = y ^ t per direction
     pos = jnp.asarray(_bit_positions(share.shape[-3]))  # [d, 2, 2]
     packed = jnp.sum(
@@ -189,11 +231,13 @@ def _expand_share_bits_jit(keys, frontier, level, derived_bits, want_children=Tr
     )  # [F, N] uint32
     if not want_children:
         return packed, None
-    # child-state cache: direction axis second-to-last (matching nb/ny's
-    # trailing direction axis), seed correction applied per ibDCF.rs:213-218
-    seeds = jnp.stack([s_l, s_r], axis=-2)  # [F, N, d, 2, 2, 4]
-    tc = st.bit[..., None, None]  # [F, N, d, 2, 1, 1]
-    seeds = jnp.where(tc, seeds ^ cw_seed[..., None, :], seeds)
+    if seeds is None:
+        # child-state cache: direction axis second-to-last (matching
+        # nb/ny's trailing direction axis), seed correction per
+        # ibDCF.rs:213-218 (the kernel applies it internally)
+        seeds = jnp.stack([s_l, s_r], axis=-2)  # [F, N, d, 2, 2, 4]
+        tc = st.bit[..., None, None]  # [F, N, d, 2, 1, 1]
+        seeds = jnp.where(tc, seeds ^ cw_seed[..., None, :], seeds)
     children = EvalState(seed=seeds, bit=nb, y_bit=ny)
     return packed, children
 

@@ -1,0 +1,39 @@
+"""Fused Pallas frontier expansion vs the XLA form — bit-exact on the
+real chip (same TPU-only gating rationale as test_keygen_pallas.py).
+
+The kernel is opt-in (collect.EXPAND_PALLAS, see the measured-layout-cost
+note there); parity is pinned here so the option stays sound.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+
+def _has_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(not _has_tpu(), reason="needs a TPU backend")
+
+
+@pytest.mark.parametrize("derived", [False, True])
+def test_expand_pallas_bit_exact(rng, derived):
+    from fuzzyheavyhitters_tpu.ops import ibdcf
+    from fuzzyheavyhitters_tpu.protocol import collect
+
+    L, n, d = 12, 300, 2  # n*d*2*F not a multiple of the kernel group
+    pts = rng.integers(0, 1 << L, size=(n, d))
+    pts_bits = ((pts[..., None] >> np.arange(L - 1, -1, -1)) & 1) > 0
+    k0, _ = ibdcf.gen_l_inf_ball(pts_bits, 3, rng, engine="np")
+    f = collect.tree_init(k0, 4)
+    for lvl in (0, 7):
+        p_x, ch_x = collect._expand_share_bits_jit(k0, f, lvl, derived, True, False)
+        p_p, ch_p = collect._expand_share_bits_jit(k0, f, lvl, derived, True, True)
+        np.testing.assert_array_equal(np.asarray(p_x), np.asarray(p_p))
+        for a, b in zip(ch_x, ch_p):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
