@@ -285,10 +285,11 @@ def bench_secure(n=1024, L=12, port=39831):
         t = time.perf_counter()
         res = await lead.run(n)
         dt = time.perf_counter() - t
-        return dt, int(res.paths.shape[0]), int(s0._gc_tests)
+        return dt, int(res.paths.shape[0]), int(s0._gc_tests), list(s0._phase_seconds)
 
     with contextlib.redirect_stdout(io.StringIO()):  # phase-timer prints
-        dt, hitters, gc_tests = asyncio.run(run())
+        dt, hitters, gc_tests, phases = asyncio.run(run())
+    fss, gcot, fld = (round(p, 3) for p in phases)
     return {
         "secure_clients_per_sec": round(n / dt, 1),
         "secure_crawl_seconds": round(dt, 3),
@@ -299,6 +300,134 @@ def bench_secure(n=1024, L=12, port=39831):
         # measured equality tests of the timed run (batches are sized to
         # the live frontier bucket, not f_max)
         "gc_tests_per_level": round(gc_tests / L, 1),
+        # server-0 accumulated 3-phase split (ref taxonomy,
+        # collect.rs:412-503); remainder vs secure_crawl_seconds is
+        # control-plane + pickling + event-loop time
+        "phase_fss_seconds": fss,
+        "phase_gc_ot_seconds": gcot,
+        "phase_field_seconds": fld,
+    }
+
+
+def bench_secure_device(n=1024, L=12, f_bucket=16):
+    """Device-resident secure-crawl measurement: the WHOLE per-level 2PC —
+    both parties' expand, label extension, garbling, evaluation, b2a,
+    alive-gated share sums — as ONE jitted program on one chip, with the
+    four data-plane messages as in-program values.
+
+    This is the 1-chip stand-in for the 2-chip mesh deployment
+    (parallel/mesh.py runs the identical math with the messages as
+    ``ppermute`` transfers): it measures what the 2PC costs where the
+    north star runs it — chips adjacent to the servers — while
+    ``bench_secure`` measures the socket e2e, which through the remote
+    - chip tunnel is floored by ~0.12 s per device<->host round trip
+    (8-10 of them per level), not by the protocol."""
+    import jax
+    import jax.numpy as jnp
+
+    from fuzzyheavyhitters_tpu.ops import baseot, gc, ibdcf, otext
+    from fuzzyheavyhitters_tpu.ops.fields import F255, FE62
+    from fuzzyheavyhitters_tpu.protocol import collect, secure
+
+    rng = np.random.default_rng(3)
+    sites = rng.integers(0, 1 << L, size=8)
+    pts = sites[rng.integers(0, 8, size=n)]
+    pts_bits = ((pts[:, None, None] >> np.arange(L - 1, -1, -1)) & 1) > 0
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng, engine="pallas")
+    d = 1
+    C, S = 1 << d, 2 * d
+    B = f_bucket * C * n
+    m = B * S
+
+    # steady-state frontier shape: f_bucket slots (root states replicated;
+    # the 2PC math is state-value-independent), all nodes+keys live so the
+    # gating work is fully exercised
+    f0 = collect.tree_init(k0, f_bucket)._replace(alive=jnp.ones(f_bucket, bool))
+    f1 = collect.tree_init(k1, f_bucket)._replace(alive=jnp.ones(f_bucket, bool))
+    alive_keys = jnp.ones(n, bool)
+    w = jnp.asarray(secure.alive_weight(np.ones(f_bucket, bool), np.ones(n, bool), C))
+
+    s_bits = otext.fresh_s_bits()
+    seeds0, seeds1, chosen = baseot.exchange(s_bits)
+    s_bits_d = jnp.asarray(s_bits.astype(np.uint32))
+    sm_snd = jnp.asarray(chosen.astype(np.uint32))
+    sm_rcv = jnp.asarray(seeds0.astype(np.uint32))
+    sa_rcv = jnp.asarray(seeds1.astype(np.uint32))
+    gseed = jnp.asarray(np.frombuffer(b"bench-gc-seed..!", "<u4").copy())
+    bseed = jnp.asarray(np.frombuffer(b"bench-b2aseed.!!", "<u4").copy())
+    derived = _prg.DERIVED_BITS
+
+    def level_fn(field):
+        limb = field.limb_shape
+
+        @jax.jit
+        def run(keys0, fr0, keys1, fr1, lvl):
+            p0, ch0 = collect._expand_share_bits_jit(keys0, fr0, lvl, derived)
+            p1, ch1 = collect._expand_share_bits_jit(keys1, fr1, lvl, derived)
+            flat0 = secure.child_strings(p0, d).reshape(B, S)  # garbler x
+            flat1 = secure.child_strings(p1, d).reshape(B, S)  # evaluator y
+            off = jnp.uint32(0)
+            u, t_rows = otext._receiver_extend(
+                sm_rcv, sa_rcv, flat1.reshape(m), off, m
+            )
+            q = otext._sender_extend(sm_snd, s_bits_d, u, off, m)
+            s_block = otext.pack_bits(s_bits_d)
+            batch, mask = gc.garble_equality_delta(
+                s_block, q.reshape(B, S, 4), gseed, flat0
+            )
+            e = gc.eval_equality(batch, t_rows.reshape(B, S, 4))
+            w_cols = -(-m // 32)
+            off2 = off + (-(-w_cols // 16))
+            u2, t2_rows = otext._receiver_extend(sm_rcv, sa_rcv, e, off2, B)
+            q2 = otext._sender_extend(sm_snd, s_bits_d, u2, off2, B)
+            idx0 = m
+            c0, c1, r1 = secure.b2a_encrypt(
+                field, q2, s_block, mask, bseed, idx0
+            )
+            v1 = secure.b2a_decrypt(field, t2_rows, idx0, c0, c1, e)
+            sh0 = secure.node_share_sums(
+                field, r1.reshape((f_bucket, C, n) + limb), w
+            )
+            sh1 = secure.node_share_sums(
+                field, v1.reshape((f_bucket, C, n) + limb), w
+            )
+            return sh0, sh1
+
+        return run
+
+    import jax.numpy as jnp  # noqa: F811
+
+    results = {}
+    for name, field in (("fe62", FE62), ("f255", F255)):
+        run = level_fn(field)
+        # correctness pin: reconstructed counts == trusted compare
+        sh0, sh1 = run(k0, f0, k1, f1, 0)
+        v = np.asarray(field.canon(field.sub(sh0, sh1)))
+        counts = v[..., 0] if field is F255 else v
+        masks = collect.pattern_masks(d)
+        p0, _ = collect.expand_share_bits(k0, f0, 0)
+        p1, _ = collect.expand_share_bits(k1, f1, 0)
+        want = np.asarray(collect.counts_by_pattern(
+            p0, p1, jnp.asarray(masks), alive_keys, jnp.ones(f_bucket, bool)
+        ))
+        assert np.array_equal(counts.astype(np.uint64), want.astype(np.uint64))
+        best = _steady_state_seconds(
+            lambda: run(k0, f0, k1, f1, 0),
+            lambda outs: int(sum(jnp.sum(jnp.asarray(o[0])[0, 0]) for o in outs)),
+            lambda o: int(jnp.sum(jnp.asarray(o[0])[0, 0])),
+            iters=32,
+        )
+        results[name] = best
+    total = results["fe62"] * (L - 1) + results["f255"]
+    return {
+        "secure_device_clients_per_sec": round(n / total, 1),
+        "secure_device_ms_per_level_fe62": round(results["fe62"] * 1000, 3),
+        "secure_device_ms_per_level_f255": round(results["f255"] * 1000, 3),
+        "secure_device_crawl_seconds": round(total, 3),
+        "n_clients": n,
+        "data_len": L,
+        "f_bucket": f_bucket,
+        "gc_tests_per_level": B,
     }
 
 
@@ -391,6 +520,11 @@ def main():
         "print(json.dumps(bench.bench_secure()))",
         timeout_s=540,
     )
+    secure_device = _subprocess_metric(
+        "import json, bench;"
+        "print(json.dumps(bench.bench_secure_device()))",
+        timeout_s=540,
+    )
     try:
         upload = bench_upload()
     except Exception as e:
@@ -408,6 +542,7 @@ def main():
                     "reference_key_bytes": BASELINE_KEY_BYTES,
                     "crawl": crawl,
                     "secure_crawl": secure,
+                    "secure_device": secure_device,
                     "upload": upload,
                 },
             }

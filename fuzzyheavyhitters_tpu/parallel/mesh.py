@@ -183,29 +183,36 @@ class MeshRunner:
             self._setup_secure()
 
     def _setup_secure(self):
-        """Host-side base-OT setup for the on-mesh 2PC: party 0 (garbler /
-        extension sender) gets its ``s``-chosen seeds, party 1 (evaluator)
-        the seed-pair columns.  The stacked [2, ...] tensors put each
-        party's material in its own mesh-row slot; the unused slots are
-        zeros (SPMD runs both roles on both parties and discards the
-        wrong-role half — branchless, like any 2-way-masked collective)."""
-        s_bits = otext.fresh_s_bits()
-        seeds0, seeds1, chosen = baseot.exchange(s_bits)
-        z = np.zeros((otext.KAPPA, 4), np.uint32)
+        """Host-side base-OT setup for the on-mesh 2PC, one session per
+        garbling DIRECTION so the leader can alternate the garbler per
+        level (the reference's ``gc_sender`` flip, rpc.rs:20-23): in
+        session ``g`` party ``g`` (garbler / extension sender) gets its
+        ``s``-chosen seeds, the other party the seed-pair columns.  The
+        stacked [2, ...] tensors put each party's material in its own
+        mesh-row slot; the unused slots are zeros (SPMD runs both roles on
+        both parties and discards the wrong-role half — branchless, like
+        any 2-way-masked collective)."""
         put = lambda a, spec: jax.device_put(
             a, NamedSharding(self.mesh, spec)
         )
-        self._s_bits = put(
-            np.stack([s_bits, np.zeros_like(s_bits)]), P(SERVERS, None)
-        )
-        self._seeds_main = put(
-            np.stack([chosen, seeds0]).astype(np.uint32), P(SERVERS, None, None)
-        )
-        self._seeds_aux = put(
-            np.stack([z, seeds1]).astype(np.uint32), P(SERVERS, None, None)
-        )
-        self._ot_blocks = 0  # column-stream block offset (lockstep)
-        self._ot_sent = 0  # pad-tweak index base
+        z = np.zeros((otext.KAPPA, 4), np.uint32)
+        self._sec = {}
+        for g in (0, 1):
+            s_bits = otext.fresh_s_bits()
+            seeds0, seeds1, chosen = baseot.exchange(s_bits)
+            zb = np.zeros_like(s_bits)
+            rows = lambda a_g, a_e: np.stack([a_g, a_e] if g == 0 else [a_e, a_g])
+            self._sec[g] = {
+                "s_bits": put(rows(s_bits, zb), P(SERVERS, None)),
+                "seeds_main": put(
+                    rows(chosen, seeds0).astype(np.uint32), P(SERVERS, None, None)
+                ),
+                "seeds_aux": put(
+                    rows(z, seeds1).astype(np.uint32), P(SERVERS, None, None)
+                ),
+                "blocks": 0,  # column-stream block offset (lockstep)
+                "sent": 0,  # pad-tweak index base
+            }
         self._sec_seed = np.frombuffer(_secrets.token_bytes(16), "<u4").copy()
         self._crawl_ctr = 0
 
@@ -266,13 +273,15 @@ class MeshRunner:
             )
         )
 
-    def _secure_counts_fn(self, field):
-        """Build (and cache) the one-program secure level crawl for a count
-        field: the whole GC+OT 2PC — label extension, garbling, evaluation,
-        b2a, alive-gated share sums — as a single shard_mapped program whose
-        only inter-party traffic is four ``ppermute`` transfers on the
-        ``servers`` axis (u-matrix, garbled batch, b2a u-matrix,
-        ciphertexts): the ICI twin of protocol/rpc.py's socket flow.
+    def _secure_counts_fn(self, field, garbler: int = 0):
+        """Build (and cache) the one-program secure level crawl for a
+        (count field, garbler party) pair: the whole GC+OT 2PC — label
+        extension, garbling, evaluation, b2a, alive-gated share sums — as
+        a single shard_mapped program whose only inter-party traffic is
+        four ``ppermute`` transfers on the ``servers`` axis (u-matrix,
+        garbled batch, b2a u-matrix, ciphertexts): the ICI twin of
+        protocol/rpc.py's socket flow.  ``garbler`` is static per program
+        (the perms are trace-time), two compiles per field.
 
         Per-data-shard uniqueness: every (0,j)<->(1,j) chip pair runs its
         own extension on the shared base seeds.  Reusing identical column
@@ -280,15 +289,16 @@ class MeshRunner:
         secrets between shards (u_A ^ u_B = r_A ^ r_B, and identical X0
         labels reveal x_A ^ x_B), so every seed is tweaked by the shard
         index inside the body — consistently on both parties."""
-        key = ("secure", field.__name__)
+        key = ("secure", field.__name__, garbler)
         if key not in self._kernel_cache:
-            self._kernel_cache[key] = self._make_secure_body(field)
+            self._kernel_cache[key] = self._make_secure_body(field, garbler)
         return self._kernel_cache[key]
 
-    def _make_secure_body(self, field):
+    def _make_secure_body(self, field, g: int):
         mesh, derived, d = self.mesh, self._derived, self.n_dims
         kspec, fspec = self._key_spec, self._frontier_spec
         limb = field.limb_shape
+        ev = 1 - g  # evaluator party of this direction
 
         def body(keys, frontier, alive_keys, s_bits, seeds_main, seeds_aux,
                  gc_seed, b2a_seed, off, sent, level):
@@ -319,16 +329,16 @@ class MeshRunner:
 
             # label delivery: evaluator's u -> garbler; labels = Δ-OT rows
             u, t_rows = otext._receiver_extend(sm, sa, flat.reshape(m), off, m)
-            u0 = jax.lax.ppermute(u, SERVERS, perm=[(1, 0)])
+            u0 = jax.lax.ppermute(u, SERVERS, perm=[(ev, g)])
             q = otext._sender_extend(sm, s_bits_l, u0, off, m)
             s_block = otext.pack_bits(s_bits_l)
             batch, mask = gc.garble_equality_delta(
                 s_block, q.reshape(B, S, 4), gseed, flat
             )
             ev_batch = gc.GarbledEqBatch(
-                tables=jax.lax.ppermute(batch.tables, SERVERS, perm=[(0, 1)]),
-                gb_labels=jax.lax.ppermute(batch.gb_labels, SERVERS, perm=[(0, 1)]),
-                decode=jax.lax.ppermute(batch.decode, SERVERS, perm=[(0, 1)]),
+                tables=jax.lax.ppermute(batch.tables, SERVERS, perm=[(g, ev)]),
+                gb_labels=jax.lax.ppermute(batch.gb_labels, SERVERS, perm=[(g, ev)]),
+                decode=jax.lax.ppermute(batch.decode, SERVERS, perm=[(g, ev)]),
             )
             e = gc.eval_equality(ev_batch, t_rows.reshape(B, S, 4))
 
@@ -336,16 +346,18 @@ class MeshRunner:
             w_cols = -(-m // 32)
             off2 = off + (-(-w_cols // 16))
             u2, t2_rows = otext._receiver_extend(sm, sa, e, off2, B)
-            u2_0 = jax.lax.ppermute(u2, SERVERS, perm=[(1, 0)])
+            u2_0 = jax.lax.ppermute(u2, SERVERS, perm=[(ev, g)])
             q2 = otext._sender_extend(sm, s_bits_l, u2_0, off2, B)
             idx0 = sent + m
-            c0g, c1g, r1 = secure.b2a_encrypt(field, q2, s_block, mask, bseed, idx0)
-            c0 = jax.lax.ppermute(c0g, SERVERS, perm=[(0, 1)])
-            c1 = jax.lax.ppermute(c1g, SERVERS, perm=[(0, 1)])
+            c0g, c1g, r1 = secure.b2a_encrypt(
+                field, q2, s_block, mask, bseed, idx0, g
+            )
+            c0 = jax.lax.ppermute(c0g, SERVERS, perm=[(g, ev)])
+            c1 = jax.lax.ppermute(c1g, SERVERS, perm=[(g, ev)])
             v1 = secure.b2a_decrypt(field, t2_rows, idx0, c0, c1, e)
 
             party = jax.lax.axis_index(SERVERS)
-            vals = jnp.where(party == 0, r1, v1)  # own additive share per test
+            vals = jnp.where(party == g, r1, v1)  # own additive share per test
             wgt = (
                 frontier_l.alive[:, None, None]
                 & alive[None, None, :]
@@ -391,9 +403,12 @@ class MeshRunner:
         """Secure crawl: both parties' additive count shares [2, F, 2^d
         (, limbs)] — reconstruct as field.sub(shares[0], shares[1]).  The
         level field mirrors the socket path: FE62 inner levels, F255 last
-        (ref: rpc.rs:60-62)."""
+        (ref: rpc.rs:60-62); the garbler alternates per level (gc_sender
+        flip), each direction consuming its own OT-extension session."""
         assert self.secure, "runner built without secure_exchange"
-        fn = self._secure_counts_fn(field)
+        g = level % 2
+        sess = self._sec[g]
+        fn = self._secure_counts_fn(field, g)
         self._crawl_ctr += 1
         gseed = secure.derive_seed(self._sec_seed, 1, level, self._crawl_ctr)
         bseed = secure.derive_seed(self._sec_seed, 2, level, self._crawl_ctr)
@@ -409,15 +424,15 @@ class MeshRunner:
         m = B * 2 * self.n_dims
         shares, self._children = fn(
             self.keys, self.frontier, self.alive_keys,
-            self._s_bits, self._seeds_main, self._seeds_aux,
+            sess["s_bits"], sess["seeds_main"], sess["seeds_aux"],
             put(gseed), put(bseed),
-            jnp.uint32(self._ot_blocks), jnp.uint32(self._ot_sent),
+            jnp.uint32(sess["blocks"]), jnp.uint32(sess["sent"]),
             jnp.int32(level),
         )
         w1 = -(-m // 32)
         w2 = -(-B // 32)
-        self._ot_blocks += (-(-w1 // 16)) + (-(-w2 // 16))
-        self._ot_sent += m + B
+        sess["blocks"] += (-(-w1 // 16)) + (-(-w2 // 16))
+        sess["sent"] += m + B
         return np.asarray(shares)
 
     def advance(self, level: int, parent_idx, pattern_bits, n_alive: int):
@@ -457,7 +472,11 @@ class MeshLeader:
                 raise RuntimeError("non-count residue in F255 mesh shares")
             return counts
         sh = r.level_count_shares(level, FE62)
-        return np.asarray(FE62.canon(FE62.sub(sh[0], sh[1]))).astype(np.uint32)
+        v = np.asarray(FE62.canon(FE62.sub(sh[0], sh[1])))
+        n = r.keys.cw_seed.shape[1]
+        if np.any(v > n):  # e.g. a share-sign/role mismatch
+            raise RuntimeError("count reconstruction out of range")
+        return v.astype(np.uint32)
 
     def run(self, nreqs: int, threshold: float):
         from ..protocol.driver import CrawlResult

@@ -101,14 +101,21 @@ class RpcLeader:
                 # — nothing to verify yet)
                 await self._both("sketch_verify", {"level": level})
             verb = "tree_crawl_last" if last else "tree_crawl"
-            s0, s1 = await self._both(verb, {"level": level})
+            # alternate the garbling server per level (the reference's
+            # gc_sender flip, leader.rs:204-210) to split garbling cost
+            s0, s1 = await self._both(
+                verb, {"level": level, "garbler": level % 2}
+            )
             if last:
                 v = np.asarray(F255.sub(s0, s1))  # leader-side reconstruct
                 counts = v[..., 0].astype(np.uint32)  # counts < 2^32 by def
                 if np.any(v[..., 1:]):  # boundary check: must survive -O
                     raise RuntimeError("non-count residue in F255 share")
             else:
-                counts = np.asarray(FE62.canon(FE62.sub(s0, s1))).astype(np.uint32)
+                v = np.asarray(FE62.canon(FE62.sub(s0, s1)))
+                if np.any(v > nreqs):  # e.g. a share-sign/role mismatch
+                    raise RuntimeError("count reconstruction out of range")
+                counts = v.astype(np.uint32)
             keep = counts >= thresh
             keep[self.n_nodes :, :] = False
             parent, pattern, n_alive = collect.compact_survivors(

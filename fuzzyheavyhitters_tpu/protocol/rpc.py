@@ -115,7 +115,9 @@ class CollectorServer:
     _children: object | None = None  # expand-time child-state cache
     _peer_reader: asyncio.StreamReader | None = None
     _peer_writer: asyncio.StreamWriter | None = None
-    _ot: object | None = None  # OT-extension endpoint (secure_exchange)
+    _ot: object | None = None  # secure-plane marker (both endpoints below)
+    _ot_snd: object | None = None  # extension sender (levels this side garbles)
+    _ot_rcv: object | None = None  # extension receiver (levels it evaluates)
     _sec_seed: np.ndarray | None = None  # session seed for GC/b2a randomness
     _crawl_ctr: int = 0  # makes per-crawl garbling randomness unique
     _last_shares: np.ndarray | None = None  # last-level leaf count shares
@@ -126,6 +128,9 @@ class CollectorServer:
     _sketch_pairs_field: object | None = None
     _sketch_seed: np.ndarray | None = None  # coin-flipped challenge seed
     _gc_tests: int = 0  # secure-mode equality tests run since reset
+    # accumulated [fss, gc_ot, field] phase seconds since reset (the
+    # reference's 3-phase level taxonomy, collect.rs:412-503)
+    _phase_seconds: list = field(default_factory=lambda: [0.0, 0.0, 0.0])
     _verb_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
 
     # -- verbs (ref: rpc.rs:56-66) ---------------------------------------
@@ -143,6 +148,7 @@ class CollectorServer:
         self._sketch_pairs = None
         self._sketch_pairs_field = None
         self._gc_tests = 0
+        self._phase_seconds = [0.0, 0.0, 0.0]
         if self._ot is not None:  # fresh GC/b2a randomness per collection
             self._sec_seed = np.frombuffer(
                 _secrets.token_bytes(16), dtype="<u4"
@@ -305,18 +311,28 @@ class CollectorServer:
         t3 = time.perf_counter()
         # per-level phase taxonomy of the reference (collect.rs:412-503);
         # trusted mode's "GC and OT" slot is the plaintext exchange
+        for i, dt in enumerate((t1 - t0, t2 - t1, t3 - t2)):
+            self._phase_seconds[i] += dt
         print(f"Tree searching and FSS - {t1 - t0:.4f}s")
         print(f"Garbled Circuit and OT - {t2 - t1:.4f}s")
         print(f"Field actions - {t3 - t2:.4f}s")
         return counts
 
     async def _crawl_counts_secure(
-        self, level: int, count_field, last: bool = False
+        self, level: int, count_field, last: bool = False, garbler: int = 0
     ) -> np.ndarray:
         """The real 2PC data plane (ref: collect.rs:419-501): GC equality +
         OT b2a over the peer socket; returns this server's additive field
         share of every per-(node, pattern) count.  No packed share-bit
-        tensor ever crosses the server boundary in this mode."""
+        tensor ever crosses the server boundary in this mode.
+
+        ``garbler`` names the server that garbles this level (the leader
+        alternates it per level — the reference's ``gc_sender`` flag,
+        rpc.rs:20-23 — so garbling cost splits across the servers); each
+        direction runs its own OT-extension session (``_setup_secure``).
+        Every data-plane message is ONE packed array: through a remote-chip
+        tunnel each device->host fetch is a full round trip, so fetch
+        count, not byte count, is the floor (see secure.pack_gc_batch)."""
         t0 = time.perf_counter()
         packed, self._children = collect.expand_share_bits(
             self.keys, self.frontier, level, want_children=not last
@@ -337,29 +353,35 @@ class CollectorServer:
         self._crawl_ctr += 1
         gc_seed = secure.derive_seed(self._sec_seed, 1, level, self._crawl_ctr)
         b2a_seed = secure.derive_seed(self._sec_seed, 2, level, self._crawl_ctr)
-        if self.server_id == 0:  # garbler + OT sender (gc_sender=true role)
+        if self.server_id == garbler:  # garbler + OT-extension sender
             u = await _recv(self._peer_reader)
-            batch, mask = secure.gb_step1(self._ot, u, flat, gc_seed)
-            await _send(self._peer_writer, tuple(np.asarray(x) for x in batch))
+            batch, mask = secure.gb_step1(self._ot_snd, u, flat, gc_seed)
+            await _send(self._peer_writer, np.asarray(secure.pack_gc_batch(batch)))
             u2 = await _recv(self._peer_reader)
-            c0, c1, vals = secure.gb_step2(self._ot, u2, mask, b2a_seed, count_field)
-            await _send(self._peer_writer, (np.asarray(c0), np.asarray(c1)))
+            c0, c1, vals = secure.gb_step2(
+                self._ot_snd, u2, mask, b2a_seed, count_field, garbler
+            )
+            await _send(self._peer_writer, np.asarray(jnp.stack([c0, c1])))
         else:  # evaluator + OT receiver
-            u, t_rows = secure.ev_step1(self._ot, np.asarray(flat))
+            u, t_rows = secure.ev_step1(self._ot_rcv, np.asarray(flat))
             await _send(self._peer_writer, np.asarray(u))
             bmsg = await _recv(self._peer_reader)
-            batch = gc.GarbledEqBatch(*(jnp.asarray(x) for x in bmsg))
+            batch = secure.unpack_gc_batch(jnp.asarray(bmsg), B, S)
             e = secure.ev_step2(batch, t_rows, B, S)
-            u2, t2_rows, idx0 = secure.ev_step3(self._ot, np.asarray(e))
+            u2, t2_rows, idx0 = secure.ev_step3(self._ot_rcv, np.asarray(e))
             await _send(self._peer_writer, np.asarray(u2))
-            c0, c1 = await _recv(self._peer_reader)
-            vals = secure.ev_step4(self._ot, t2_rows, idx0, c0, c1, e, count_field)
+            cts = jnp.asarray(await _recv(self._peer_reader))
+            vals = secure.ev_step4(
+                self._ot_rcv, t2_rows, idx0, cts[0], cts[1], e, count_field
+            )
         jax.block_until_ready(vals)
         t2 = time.perf_counter()
         vals = vals.reshape((F_, C, N) + count_field.limb_shape)
         shares = secure.node_share_sums(count_field, vals, jnp.asarray(w))
         shares = np.asarray(shares)
         t3 = time.perf_counter()
+        for i, dt in enumerate((t1 - t0, t2 - t1, t3 - t2)):
+            self._phase_seconds[i] += dt
         print(f"Tree searching and FSS - {t1 - t0:.4f}s")
         print(f"Garbled Circuit and OT - {t2 - t1:.4f}s")
         print(f"Field actions - {t3 - t2:.4f}s")
@@ -369,7 +391,9 @@ class CollectorServer:
         """-> FE62 shares of per-child counts [F, 2^d] (ref: rpc.rs:60)."""
         level = req["level"]
         if self.cfg.secure_exchange:
-            return await self._crawl_counts_secure(level, FE62)
+            return await self._crawl_counts_secure(
+                level, FE62, garbler=int(req.get("garbler", 0))
+            )
         counts = await self._crawl_counts(level)
         # NB: trusted mode — both servers hold these plaintext counts; the
         # shared-seed mask below is a WIRE-FORMAT shim so the leader's
@@ -387,7 +411,9 @@ class CollectorServer:
         mode).  Shares are retained for final_shares re-serving."""
         level = req["level"]
         if self.cfg.secure_exchange:
-            shares = await self._crawl_counts_secure(level, F255, last=True)
+            shares = await self._crawl_counts_secure(
+                level, F255, last=True, garbler=int(req.get("garbler", 0))
+            )
         else:
             counts = await self._crawl_counts(level, last=True)
             r = mask_f255(level, counts.size).reshape(counts.shape + (8,))
@@ -591,23 +617,29 @@ class CollectorServer:
     async def _setup_secure(self):
         """One-time base-OT setup seeding the IKNP extension (the ocelot
         session init of collect.rs:454-461 — ~128 host-side Chou-Orlandi
-        OTs; all per-level OT volume then runs as device kernels).  Server 0
-        (garbler / OT-extension sender) plays base-OT *receiver* with its
-        secret ``s`` — the standard IKNP role flip (ops/otext.py)."""
+        OTs; all per-level OT volume then runs as device kernels).  TWO
+        sessions, one per garbling direction, so the leader can alternate
+        the garbler per level (the reference's ``gc_sender`` flip,
+        rpc.rs:20-23, leader.rs:204-210) and garbling cost splits across
+        the servers.  In session ``g`` server ``g`` is the OT-extension
+        sender and plays base-OT *receiver* with its secret ``s`` — the
+        standard IKNP role flip (ops/otext.py)."""
         if not self.cfg.secure_exchange:
             return
-        if self.server_id == 1:
-            bs = baseot.BaseOtSender()
-            await _send(self._peer_writer, bs.round1())
-            r_msgs = await _recv(self._peer_reader)
-            s0, s1 = bs.seeds([baseot.decompress(m) for m in r_msgs])
-            self._ot = otext.OtExtReceiver(s0, s1)
-        else:
-            s_bits = otext.fresh_s_bits()
-            a_msg = await _recv(self._peer_reader)
-            br = baseot.BaseOtReceiver(s_bits)
-            await _send(self._peer_writer, br.round1(a_msg))
-            self._ot = otext.OtExtSender(s_bits, br.seeds())
+        for g in (0, 1):
+            if self.server_id == g:  # extension sender <- base-OT receiver
+                s_bits = otext.fresh_s_bits()
+                a_msg = await _recv(self._peer_reader)
+                br = baseot.BaseOtReceiver(s_bits)
+                await _send(self._peer_writer, br.round1(a_msg))
+                self._ot_snd = otext.OtExtSender(s_bits, br.seeds())
+            else:  # extension receiver <- base-OT sender
+                bs = baseot.BaseOtSender()
+                await _send(self._peer_writer, bs.round1())
+                r_msgs = await _recv(self._peer_reader)
+                s0, s1 = bs.seeds([baseot.decompress(m) for m in r_msgs])
+                self._ot_rcv = otext.OtExtReceiver(s0, s1)
+        self._ot = (self._ot_snd, self._ot_rcv)  # marker: secure plane live
         self._sec_seed = np.frombuffer(
             _secrets.token_bytes(16), dtype="<u4"
         ).copy()

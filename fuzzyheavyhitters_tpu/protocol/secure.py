@@ -28,10 +28,12 @@ data-plane socket (message flow: ev u-matrix → gb garbled batch → ev b2a
 u-matrix → gb ciphertexts — two round trips per level), and parallel/mesh.py
 runs the same math with ``ppermute`` transfers on the 2-chip axis.
 
-Wire-share semantics: garbler's per-test share is always ``r1 = r0 + 1``;
-the evaluator receives ``r0`` when the strings are equal, else ``r1`` —
-so ``v0 - v1 = [x == y]`` per test and summed shares reconstruct counts
-exactly like ``keep_values`` (collect.rs:945-964).
+Wire-share semantics: the garbler's per-test share is ``r1 = r0 ± 1``
+(+1 when server 0 garbles, −1 when server 1 does — the garbler flips per
+level, ref rpc.rs:20-23); the evaluator receives ``r0`` when the strings
+are equal, else ``r1`` — so ``v0 - v1 = [x == y]`` per test REGARDLESS
+of which side garbled, and summed shares reconstruct counts exactly like
+``keep_values`` (collect.rs:945-964).
 """
 
 from __future__ import annotations
@@ -143,12 +145,15 @@ def ev_step3(rcv: otext.OtExtReceiver, e_bits):
     return u2, t2, idx0
 
 
-def b2a_encrypt(field, q2_rows, s_block, mask, b2a_seed, idx0):
-    """Stateless b2a sender core: sample (r0, r1 = r0+1), order payloads by
-    ``mask`` (collect.rs:439-456), encrypt under the OT pads derived from
-    the Q rows.  Returns (c0, c1 ciphertext words [B, W], r1 — the sender's
-    additive shares).  Shared by the socket path (gb_step2) and the mesh
-    kernel (parallel/mesh.py) so the trick lives in exactly one place."""
+def b2a_encrypt(field, q2_rows, s_block, mask, b2a_seed, idx0, garbler: int = 0):
+    """Stateless b2a sender core: sample (r0, r1 = r0 ± 1), order payloads
+    by ``mask`` (collect.rs:439-456), encrypt under the OT pads derived
+    from the Q rows.  Returns (c0, c1 ciphertext words [B, W], r1 — the
+    sender's additive shares).  ``garbler`` fixes the share SIGN so the
+    leader's uniform ``v0 - v1`` reconstruction holds whichever server
+    garbles: server 0 keeps ``r0 + 1``, server 1 keeps ``r0 - 1``.
+    Shared by the socket path (gb_step2) and the mesh kernel
+    (parallel/mesh.py) so the trick lives in exactly one place."""
     mask = jnp.asarray(mask, bool)
     B = mask.shape[0]
     W = payload_words(field)
@@ -157,7 +162,8 @@ def b2a_encrypt(field, q2_rows, s_block, mask, b2a_seed, idx0):
     pad1 = otext.ot_hash(q2_rows ^ jnp.asarray(s_block), W, idx0)
     r_words = prg.stream_words(jnp.asarray(b2a_seed, jnp.uint32), B * W).reshape(B, W)
     r0 = field.sample(r_words)
-    r1 = field.add(r0, field.from_int(1))
+    one = field.from_int(1)
+    r1 = field.sub(r0, one) if garbler else field.add(r0, one)
     w0, w1 = field_to_words(field, r0), field_to_words(field, r1)
     m0 = jnp.where(mask[:, None], w0, w1)
     m1 = jnp.where(mask[:, None], w1, w0)
@@ -174,21 +180,54 @@ def b2a_decrypt(field, t2_rows, idx0, c0, c1, e_bits):
     return words_to_field(field, ct ^ pad)
 
 
-def gb_step2(snd: otext.OtExtSender, u2_msg, mask, b2a_seed, field):
+def gb_step2(snd: otext.OtExtSender, u2_msg, mask, b2a_seed, field, garbler: int = 0):
     """Garbler: extend the b2a OT and run :func:`b2a_encrypt`.
 
-    Returns (c0, c1 ciphertext words [B, W], v0 field values [B] — the
-    garbler's additive shares, always r1)."""
+    Returns (c0, c1 ciphertext words [B, W], field values [B] — the
+    garbler's additive shares, always r1 = r0 ± 1 by ``garbler`` side)."""
     B = jnp.asarray(mask).shape[0]
     idx0 = snd.consumed
     q2 = snd.extend(B, u2_msg)
-    return b2a_encrypt(field, q2, snd.s_block, mask, b2a_seed, idx0)
+    return b2a_encrypt(field, q2, snd.s_block, mask, b2a_seed, idx0, garbler)
 
 
 def ev_step4(rcv: otext.OtExtReceiver, t2_rows, idx0, c0, c1, e_bits, field):
     """Evaluator: decrypt its chosen payload -> field values [B] (its
     additive shares: r0 where equal, r1 where not)."""
     return b2a_decrypt(field, t2_rows, idx0, c0, c1, e_bits)
+
+
+# ---------------------------------------------------------------------------
+# Wire packing: one buffer per message
+# ---------------------------------------------------------------------------
+#
+# Through a remote-chip tunnel every device->host fetch costs a full round
+# trip (~120 ms measured) regardless of size, so a message that fetches
+# three arrays pays three RTTs.  Packing the garbled batch (and the b2a
+# ciphertext pair) into ONE u32 vector on device makes each data-plane
+# message one fetch + one pickle; the peer re-uploads once and slices on
+# device.
+
+
+@jax.jit
+def pack_gc_batch(batch: gc.GarbledEqBatch) -> jax.Array:
+    return jnp.concatenate([
+        jnp.ravel(batch.tables),
+        jnp.ravel(batch.gb_labels),
+        jnp.ravel(batch.decode).astype(jnp.uint32),
+    ])
+
+
+@partial(jax.jit, static_argnames=("B", "S"))
+def unpack_gc_batch(buf: jax.Array, B: int, S: int) -> gc.GarbledEqBatch:
+    buf = jnp.asarray(buf)
+    nt = B * (S - 1) * 2 * 4
+    nl = B * S * 4
+    return gc.GarbledEqBatch(
+        tables=buf[:nt].reshape(B, S - 1, 2, 4),
+        gb_labels=buf[nt : nt + nl].reshape(B, S, 4),
+        decode=buf[nt + nl :] != 0,
+    )
 
 
 # ---------------------------------------------------------------------------
