@@ -90,17 +90,19 @@ async def amain() -> None:
 
     sk0 = sk1 = None
     if cfg.malicious:
-        # malicious-security material: MAC'd payload DPFs over the client's
-        # point + Beaver triples (protocol/sketch.py; ref north star names
-        # the resurrected sketch.rs path)
-        if cfg.n_dims != 1:
-            raise SystemExit("malicious mode requires n_dims == 1 (one-hot sketch)")
+        # malicious-security material: per-dimension MAC'd payload DPFs
+        # over the client's point + Beaver triples (protocol/sketch.py;
+        # ref north star names the resurrected sketch.rs path).  Works
+        # for the flagship fuzzy multi-dim workloads: one DPF per dim
+        # sharing the client's MAC key, verified per dim.
         from ..ops.fields import F255, FE62
         from ..protocol import sketch as sketchmod
 
-        seeds = rng.integers(0, 2**32, size=(nreqs, 2, 4), dtype=np.uint32)
+        seeds = rng.integers(
+            0, 2**32, size=(nreqs, cfg.n_dims, 2, 4), dtype=np.uint32
+        )
         cseed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
-        sk0, sk1 = sketchmod.gen(seeds, pts[:, 0, :], FE62, F255, cseed)
+        sk0, sk1 = sketchmod.gen(seeds, pts, FE62, F255, cseed)
 
     h0, p0 = _split(cfg.server0)
     h1, p1 = _split(cfg.server1)

@@ -91,15 +91,21 @@ class RpcLeader:
         self.n_nodes = 1
         thresh = max(1, int(cfg.threshold * nreqs))
         counts_kept = np.zeros(0, np.uint32)
+        alive_before_leaf = None  # liveness after the latest verify
         for level in range(L):
             last = level == L - 1
-            if self.has_sketch and level >= 1:
-                # malicious-security gate first: the frontier-following
-                # sketch shares stored by the previous prune are verified,
-                # so failing clients' liveness flags flip before this
-                # level's counts are taken (depth-0 has a single root node
-                # — nothing to verify yet)
-                await self._both("sketch_verify", {"level": level})
+            if self.has_sketch and level != 1:
+                # malicious-security gate first, so failing clients'
+                # liveness flags flip before this level's counts are
+                # taken.  Level 0 runs the FULL depth-1 check (both root
+                # children per dim) — the first threshold never sees
+                # unverified counts; levels >= 2 verify the
+                # frontier-following shares stored by the previous prune.
+                # The depth-1 frontier re-verify (level 1) is skipped: its
+                # triples were consumed by the level-0 full check (see
+                # rpc.sketch_verify / sketch.py scope note).
+                a0, _ = await self._both("sketch_verify", {"level": level})
+                alive_before_leaf = np.asarray(a0)
             verb = "tree_crawl_last" if last else "tree_crawl"
             # alternate the garbling server per level (the reference's
             # gc_sender flip, leader.rs:204-210) to split garbling cost
@@ -153,12 +159,23 @@ class RpcLeader:
             self.paths = new_paths
             self.n_nodes = n_alive
             counts_kept = counts[parent[:n_alive], pattern[:n_alive]]
-        if self.has_sketch:
+        if self.has_sketch and L > 1:
             # final F255 leaf-payload check (surviving leaves; counts for
             # this collection are already taken — the verdict gates the
-            # liveness flags for any further use and flags forged leaves)
-            a0, a1 = await self._both("sketch_verify", {"level": L})
-            if not (np.asarray(a0).all() and np.asarray(a1).all()):
+            # liveness flags for any further use and flags forged leaves).
+            # Warn only on NEW exclusions relative to the latest verify:
+            # a client caught mid-tree stays excluded and must not read
+            # as a leaf forgery.  data_len == 1 skips this call entirely:
+            # there the level-0 full check IS the leaf check, and a second
+            # opening of triples_last under a fresh challenge would leak
+            # <r - r', x> (see rpc.sketch_verify).
+            a0, _ = await self._both("sketch_verify", {"level": L})
+            prev = (
+                alive_before_leaf
+                if alive_before_leaf is not None
+                else np.ones_like(np.asarray(a0))
+            )
+            if np.any(prev & ~np.asarray(a0)):
                 print("WARNING: forged sketch leaf payload detected")
         # final reconstruction from re-served leaf shares: v0 - v1 per
         # surviving leaf (ref: collect.rs:993-1029 final_shares/final_values;

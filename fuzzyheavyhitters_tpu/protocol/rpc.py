@@ -123,8 +123,9 @@ class CollectorServer:
     _last_shares: np.ndarray | None = None  # last-level leaf count shares
     _sketch_parts: list = field(default_factory=list)
     _sketch: object | None = None  # SketchKeyBatch (malicious-secure mode)
-    _sketch_states: object | None = None  # DpfEvalState [F, N], frontier-following
-    _sketch_pairs: tuple | None = None  # (pair shares [F, N, lanes], depth)
+    _sketch_states: object | None = None  # DpfEvalState [F, N, d], frontier-following
+    _sketch_pids: np.ndarray | None = None  # int32[F, d] per-dim prefix ids
+    _sketch_pairs: tuple | None = None  # (pair shares [F, N, d, lanes], depth)
     _sketch_pairs_field: object | None = None
     _sketch_seed: np.ndarray | None = None  # coin-flipped challenge seed
     _gc_tests: int = 0  # secure-mode equality tests run since reset
@@ -145,6 +146,7 @@ class CollectorServer:
         self._sketch_parts.clear()
         self._sketch = None
         self._sketch_states = None
+        self._sketch_pids = None
         self._sketch_pairs = None
         self._sketch_pairs_field = None
         self._gc_tests = 0
@@ -190,40 +192,70 @@ class CollectorServer:
             self._sketch = jax.tree.unflatten(
                 jax.tree.structure(_SKETCH_TREEDEF), cat
             )
-            if self.keys.cw_seed.shape[1] != 1:
-                raise RuntimeError("sketch verification covers n_dims=1")
-            root = dpf.eval_init(self._sketch.key)  # [N]
+            root = dpf.eval_init(self._sketch.key)  # [N, d]
             self._sketch_states = jax.tree.map(
                 lambda a: jnp.broadcast_to(a[None], (1,) + a.shape), root
+            )
+            self._sketch_pids = np.zeros(
+                (1, self._sketch.key.root_seed.shape[1]), np.int32
             )
             self._sketch_pairs = None
         return True
 
     async def sketch_verify(self, req) -> np.ndarray:
-        """Malicious-security check over the *current frontier* (ref
-        intent: the TreeSketchFrontier* verb vestiges rpc.rs:40-51, gate at
-        collect.rs:495): the value-pair shares stored by the latest prune's
-        sketch-state advance feed the sketch inner products + Beaver
-        verification over the peer data plane; failing clients' liveness
-        flags flip before this level's counts are taken.
+        """Malicious-security check (ref intent: the TreeSketchFrontier*
+        verb vestiges rpc.rs:40-51, gate at collect.rs:495): sketch inner
+        products + Beaver verification over the peer data plane, per
+        (client, dim) — a client fails if ANY dim fails; failing clients'
+        liveness flags flip before the gated counts are taken.
+
+        Depth semantics: ``level == 0`` verifies the FULL depth-1 level
+        (both children of every dim's root, evaluated on the fly) so the
+        first threshold never acts on unverified counts; ``level >= 2``
+        verifies the depth-``level`` frontier shares stored by the prune
+        of ``level - 1``.  Depth 1's frontier re-verify is deliberately
+        absent — its Beaver triples were consumed by the level-0 full
+        check, and re-opening them under a second challenge would leak
+        ``<r - r', x>`` (see protocol/sketch.py scope note).
 
         The challenge randomness comes from the per-session coin-flipped
-        seed (``_setup_data_plane``), never a public constant — a client
-        must not be able to predict r.  Depth ``level`` means: shares of
-        the depth-``level`` frontier (stored at prune of ``level - 1``);
-        the leader calls this for levels >= 1."""
+        seed (``_plane_handshake``), never a public constant — a client
+        must not be able to predict r."""
         if self._sketch is None:
             raise RuntimeError("sketch_verify without sketch keys")
         level = int(req["level"])
-        if self._sketch_pairs is None or self._sketch_pairs[1] != level:
-            raise RuntimeError(
-                f"no stored sketch shares for depth {level}"
-            )
-        pairs_fn, _ = self._sketch_pairs
-        last = self._sketch_pairs_field is F255
-        fld = self._sketch_pairs_field
-        n = self.alive_keys.shape[0]
-        f_bucket = pairs_fn.shape[0]  # stored shares' node bucket
+        k = self._sketch.key
+        L = k.data_len
+        n, d = k.root_seed.shape[0], k.root_seed.shape[1]
+        if level == 0:
+            # full-width depth-1 check: both children of the root per dim
+            last = L == 1
+            fld = F255 if last else FE62
+            st = jax.tree.map(lambda a: a[0], self._sketch_states)  # [N, d]
+            cw = dpf.level_cw(k, 0)
+            cwv = k.cw_val[..., 0, :] if not last else k.cw_val_last
+            sides = []
+            for c in (False, True):
+                _, p = dpf.eval_bit(
+                    cw, st, jnp.full((n, d), c), cwv, k.key_idx, fld,
+                    sketchmod.LANES,
+                )
+                sides.append(p)
+            pairs_fn = jnp.stack(sides)  # [2, N, d, LANES(, limbs)]
+            m_nodes, dpf_level = 2, 0
+        else:
+            if L == 1:
+                # the level-0 full check already consumed triples_last; a
+                # second opening under a fresh challenge leaks <r - r', x>
+                raise RuntimeError(
+                    "data_len=1: the leaf check is the level-0 full check"
+                )
+            if self._sketch_pairs is None or self._sketch_pairs[1] != level:
+                raise RuntimeError(f"no stored sketch shares for depth {level}")
+            pairs_fn, _ = self._sketch_pairs  # [F, N, d, LANES(, limbs)]
+            fld = self._sketch_pairs_field
+            last = fld is F255
+            m_nodes, dpf_level = pairs_fn.shape[0], level - 1
         bs = max(
             1,
             self.cfg.sketch_batch_size_last if last else self.cfg.sketch_batch_size,
@@ -234,17 +266,19 @@ class CollectorServer:
             ks = jax.tree.map(lambda a: a[sl], self._sketch)
             n_sl = ok[sl].shape[0]
             r, rands = sketchmod.shared_r_stream(
-                fld, self._sketch_seed, level, f_bucket, n_sl
+                fld, self._sketch_seed, level, m_nodes, n_sl * d
             )
-            pairs = pairs_fn[:, sl]  # [F, n_sl, lanes(, limbs)]
-            pairs = jnp.moveaxis(jnp.asarray(pairs), 0, 1)  # [n_sl, F, ...]
+            rands = rands.reshape((n_sl, d, 3) + fld.limb_shape)
+            pairs = pairs_fn[:, sl]  # [F, n_sl, d, lanes(, limbs)]
+            pairs = jnp.moveaxis(jnp.asarray(pairs), 0, 2)  # [n_sl, d, F, ...]
             out = sketchmod.sketch_output(fld, pairs, r, rands)
-            dpf_level = level - 1
             if last:
                 trip, mk, mk2 = ks.triples_last, ks.mac_key_last, ks.mac_key2_last
             else:
-                trip = jax.tree.map(lambda a: a[:, dpf_level], ks.triples)
+                trip = jax.tree.map(lambda a: a[..., dpf_level, :], ks.triples)
                 mk, mk2 = ks.mac_key, ks.mac_key2
+            mk = jnp.expand_dims(jnp.asarray(mk), 1)  # broadcast over dims
+            mk2 = jnp.expand_dims(jnp.asarray(mk2), 1)
             state = sketchmod.mul_state(fld, out, mk, mk2, trip)
             cs = tuple(np.asarray(x) for x in mpc.cor_share(fld, state))
             peer_cs = await self._swap(cs)
@@ -254,31 +288,53 @@ class CollectorServer:
                 mpc.out_share(fld, bool(self.server_id), state, opened)
             )
             peer_o = await self._swap(o)
-            ok[sl] = np.asarray(mpc.verify(fld, o, peer_o))
+            ok_nd = np.asarray(mpc.verify(fld, o, peer_o))  # [n_sl, d]
+            ok[sl] = ok_nd.all(axis=1)
         self.alive_keys &= ok
         return self.alive_keys.copy()
 
     def _advance_sketch(self, level: int, parent: np.ndarray, pat_bits: np.ndarray, n_alive: int):
         """Advance the frontier-following sketch DPF states with the same
-        survivor table as the count frontier (the sketch tree is 1-D; its
-        direction is dim 0's pattern bit), storing the new depth's
-        value-pair shares gated by node liveness."""
+        survivor table as the count frontier (one 1-D sketch tree per
+        dimension; dim j's direction is pattern bit j), storing the new
+        depth's value-pair shares gated by node liveness AND per-dim
+        prefix DEDUPLICATION: in d > 1 the count frontier is a product —
+        two frontier nodes routinely share the same dim-j prefix, and
+        counting an honest one-hot entry twice makes ``<r,x>² != <r²,x>``
+        (with r_i + r_j in place of a single r).  Each dim keeps only the
+        FIRST slot of every distinct prefix; the dedup table derives from
+        the public survivor table, so both servers gate identically."""
         L = self.keys.cw_seed.shape[-2]
         last = level == L - 1
         fld = F255 if last else FE62
-        k = self._sketch.key
-        st = jax.tree.map(lambda a: a[np.asarray(parent)], self._sketch_states)
-        direction = jnp.asarray(pat_bits[:, 0], bool)[:, None]  # [F, 1]
-        cw = tuple(a[None] for a in dpf.level_cw(k, level))  # broadcast [1, N, ...]
-        cwv = (k.cw_val[:, level] if not last else k.cw_val_last)[None]
+        k = self._sketch.key  # batch [N, d]
+        d = k.root_seed.shape[1]
+        parent = np.asarray(parent)
+        st = jax.tree.map(lambda a: a[parent], self._sketch_states)
+        direction = jnp.asarray(pat_bits, bool)[:, None, :]  # [F, 1, d]
+        cw = tuple(a[None] for a in dpf.level_cw(k, level))  # [1, N, d, ...]
+        cwv = (k.cw_val[..., level, :] if not last else k.cw_val_last)[None]
         new_st, pair = dpf.eval_bit(
             cw, st, direction, cwv, k.key_idx[None], fld, sketchmod.LANES
+        )  # pair [F, N, d, LANES(, limbs)]
+        F2 = parent.shape[0]
+        pids = np.zeros((F2, d), np.int32)
+        keep = np.zeros((F2, d), bool)
+        parent_pid = self._sketch_pids[parent[:n_alive]]  # [n_alive, d]
+        for j in range(d):
+            key_j = np.stack(
+                [parent_pid[:, j], pat_bits[:n_alive, j].astype(np.int32)], 1
+            )
+            _, inv = np.unique(key_j, axis=0, return_inverse=True)
+            pids[:n_alive, j] = inv
+            _, first = np.unique(inv, return_index=True)
+            keep[first, j] = True
+        gate = jnp.asarray(
+            keep.reshape((F2, 1, d) + (1,) * (pair.ndim - 3))
         )
-        alive = (np.arange(parent.shape[0]) < n_alive)[:, None, None]
-        if fld.limb_shape:
-            alive = alive[..., None]
-        pair = jnp.where(jnp.asarray(alive), pair, 0)
+        pair = jnp.where(gate, pair, 0)
         self._sketch_states = new_st
+        self._sketch_pids = pids
         self._sketch_pairs = (pair, level + 1)
         self._sketch_pairs_field = fld
 

@@ -22,14 +22,40 @@ path — and verify with Beaver triples (protocol/mpc.py) that
 ``alive_keys`` liveness flag that already gates every count
 (collect.rs:32, 495 — the hook upstream left for exactly this).
 
-Scope note, stated honestly: in the reference's *ancestor* the payload
-DPF was also the counting path, so the sketch protected the counts
-directly; the reference replaced that path with the GC+OT equality
-protocol and left the sketch dead.  Here the sketch runs as the
-malicious-security scaffold alongside the ibDCF path — same protocol,
-same checks, same liveness gate — over the 1-D string workloads the
-upstream sketch covered (a one-hot vector check does not extend to fuzzy
-L∞ balls, which contain many nodes per level).
+**Multi-dimensional clients** (round 4, the flagship fuzzy workloads):
+a client with ``d`` dimensions submits ``d`` independent 1-D payload
+DPFs — one per dimension's point — SHARING one MAC key ``k`` (and one
+``k_last``), with per-(dim, level) Beaver triples.  Verification runs
+the three checks per (client, dim); a client is excluded if ANY dim
+fails.  A one-hot check cannot range over a d-dim product tree (an L∞
+ball contains many nodes per level), but per-dim one-hot-ness + the
+shared MAC pins each dimension's contribution to a single path, which
+is exactly the shape of an honest fuzzy submission.
+
+Scope note, stated honestly:
+
+- In the reference's *ancestor* the payload DPF was also the counting
+  path, so the sketch protected the counts directly; the reference
+  replaced that path with the GC+OT equality protocol and left the
+  sketch dead (sketch.rs commented out).  Here the sketch runs as the
+  malicious-security scaffold alongside the ibDCF path — same protocol,
+  same checks, same liveness gate (collect.rs:32, 495) — so it bounds a
+  client's contribution SHAPE (one path per dim, MAC'd), not the ibDCF
+  key bits themselves.
+- **Frontier restriction** (server path, rpc.sketch_verify): from depth
+  2 onward the servers verify the shares of frontier-surviving nodes
+  only.  This is sound for COUNT integrity: a share living only on
+  pruned nodes is, by the liveness gate, never summed into any count a
+  threshold or the final output reads — verifying it would not change
+  any protocol output.  What frontier restriction gives up is detection
+  (a cheater whose malformation never meets the frontier keeps its
+  liveness flag), not correctness of counts.
+- **Depth 1 is verified in full** before the first threshold: the
+  leader's level-0 ``sketch_verify`` evaluates BOTH children of the
+  root per dim and checks the whole 2-node level, so level-0 pruning
+  never acts on unverified counts (closing the round-3 gap); the
+  depth-1 frontier re-verify is then skipped — re-opening the same
+  Beaver triples for a second challenge would leak ``<r - r', x>``.
 """
 
 from __future__ import annotations
@@ -49,15 +75,19 @@ LANES = 2  # payload lanes: (x, k·x)
 
 
 class SketchKeyBatch(NamedTuple):
-    """One party's sketch keys for N clients (ref: sketch.rs:14-24)."""
+    """One party's sketch keys for N clients (ref: sketch.rs:14-24).
 
-    key: DpfKeyBatch
+    The DPF batch carries one key per (client, dim) — batch dims
+    ``[N, d]`` — while the MAC keys are per CLIENT (shared across the
+    client's dims); triples are per (client, dim, level, check)."""
+
+    key: DpfKeyBatch  # batch dims [N, d]
     mac_key: jax.Array  # field_t share [N]
     mac_key2: jax.Array  # field_t share of k² [N]
     mac_key_last: jax.Array  # field_u share [N(, limbs)]
     mac_key2_last: jax.Array
-    triples: mpc.TripleBatch  # field_t [N, L-1, CHECKS]
-    triples_last: mpc.TripleBatch  # field_u [N, CHECKS(, limbs)]
+    triples: mpc.TripleBatch  # field_t [N, d, L-1, CHECKS]
+    triples_last: mpc.TripleBatch  # field_u [N, d, CHECKS(, limbs)]
 
 
 class SketchOutput(NamedTuple):
@@ -75,13 +105,19 @@ class SketchOutput(NamedTuple):
 def gen(init_seeds, alpha_bits, field_t, field_u, seed) -> tuple[SketchKeyBatch, SketchKeyBatch]:
     """Client-side keygen (ref: sketch.rs:79-149 ``gen``/``gen_from_str``):
     unit payloads (x = 1 at the client's prefix) MAC'd with fresh per-client
-    keys; triples for every level's checks ride along.
+    keys; triples for every (dim, level)'s checks ride along.
 
-    init_seeds: uint32[N, 2, 4]; alpha_bits: bool[N, L]; seed: uint32[4]
-    client-side randomness (MAC keys, shares, triples).
+    init_seeds: uint32[N(, d), 2, 4]; alpha_bits: bool[N(, d), L] — one
+    payload DPF per dimension sharing the client's MAC key; seed:
+    uint32[4] client-side randomness (MAC keys, shares, triples).  1-D
+    callers may omit the dim axis (normalized to d = 1).
     """
     alpha_bits = np.asarray(alpha_bits, bool)
-    N, L = alpha_bits.shape
+    init_seeds = np.asarray(init_seeds, np.uint32)
+    if alpha_bits.ndim == 2:  # [N, L] -> [N, 1, L]
+        alpha_bits = alpha_bits[:, None, :]
+        init_seeds = init_seeds[:, None]
+    N, d, L = alpha_bits.shape
     wt = 4
     wu = 8 if field_u.limb_shape else 4
     seed = jnp.asarray(seed, jnp.uint32)
@@ -89,7 +125,7 @@ def gen(init_seeds, alpha_bits, field_t, field_u, seed) -> tuple[SketchKeyBatch,
     def sub_seed(tag):
         return seed ^ jnp.asarray([0, 0, 0, tag], jnp.uint32)
 
-    # MAC keys + shares
+    # MAC keys + shares (per client, shared across its dims)
     k = field_t.sample(prg.stream_words(sub_seed(1), N * wt).reshape(N, wt))
     k2 = field_t.mul(k, k)
     k_last = field_u.sample(prg.stream_words(sub_seed(2), N * wu).reshape(N, wu))
@@ -108,19 +144,19 @@ def gen(init_seeds, alpha_bits, field_t, field_u, seed) -> tuple[SketchKeyBatch,
 
     # payload values: inner levels (1, k) in T; last level (1, k_last) in U
     one_t = jnp.broadcast_to(field_t.from_int(1), (N,))
-    vals = jnp.stack([one_t, k], axis=-1)[:, None, :]  # [N, 1, 2]
-    vals = jnp.broadcast_to(vals, (N, L - 1, LANES))
-    one_u = jnp.broadcast_to(
-        field_u.from_int(1), (N,) + field_u.limb_shape
-    )
-    vals_last = jnp.stack([one_u, k_last], axis=1)  # [N, LANES(, limbs)]
+    vals = jnp.stack([one_t, k], axis=-1)[:, None, None, :]  # [N, 1, 1, 2]
+    vals = jnp.broadcast_to(vals, (N, d, L - 1, LANES))
+    limb = field_u.limb_shape
+    one_u = jnp.broadcast_to(field_u.from_int(1), (N, d) + limb)
+    k_lb = jnp.broadcast_to(k_last[:, None], (N, d) + limb)
+    vals_last = jnp.stack([one_u, k_lb], axis=2)  # [N, d, LANES(, limbs)]
 
     dk0, dk1 = dpf.gen_pair(
         init_seeds, alpha_bits, vals, vals_last, field_t, field_u, LANES
     )
 
-    t0, t1 = mpc.gen_triples(field_t, (N, L - 1, mpc.CHECKS), sub_seed(7))
-    tl0, tl1 = mpc.gen_triples(field_u, (N, mpc.CHECKS), sub_seed(8))
+    t0, t1 = mpc.gen_triples(field_t, (N, d, L - 1, mpc.CHECKS), sub_seed(7))
+    tl0, tl1 = mpc.gen_triples(field_u, (N, d, mpc.CHECKS), sub_seed(8))
 
     def mk(p, dk, trip, trip_last):
         return SketchKeyBatch(
@@ -136,19 +172,20 @@ def gen(init_seeds, alpha_bits, field_t, field_u, seed) -> tuple[SketchKeyBatch,
     return mk(0, dk0, t0, tl0), mk(1, dk1, t1, tl1)
 
 
-def shared_r_stream(field, shared_seed, level: int, m: int, n_clients: int):
+def shared_r_stream(field, shared_seed, level: int, m: int, n_rand: int):
     """The servers' common sketch randomness for one level: per-node r_j
-    (and r_j²) plus per-client rand1..3 — both servers derive identical
-    values from the shared seed (the reference's shared rand_stream,
-    sketch.rs:164-168, seeded like server.rs:331-332)."""
+    (and r_j²) plus ``n_rand`` rows of rand1..3 (one row per client×dim) —
+    both servers derive identical values from the shared seed (the
+    reference's shared rand_stream, sketch.rs:164-168, seeded like
+    server.rs:331-332)."""
     w = 8 if field.limb_shape else 4
     s = jnp.asarray(shared_seed, jnp.uint32) ^ jnp.asarray(
         [0, 0, 0x5E71C, level], jnp.uint32
     )
-    words = prg.stream_words(s, (m + 3 * n_clients) * w)
+    words = prg.stream_words(s, (m + 3 * n_rand) * w)
     r = field.sample(words[: m * w].reshape((m, w)))
     rands = field.sample(
-        words[m * w :].reshape((n_clients, 3, w))
+        words[m * w :].reshape((n_rand, 3, w))
     )
     return r, rands
 
@@ -157,18 +194,22 @@ def shared_r_stream(field, shared_seed, level: int, m: int, n_clients: int):
 def sketch_output(field, pair_shares, r, rands) -> SketchOutput:
     """Batched sketch inner products (ref: sketch.rs:157-199 sketch_at).
 
-    pair_shares: field[N, M, LANES(, limbs)] — this server's value-pair
-    shares over the M tree nodes of the level; r: field[M(, limbs)] shared
-    random vector; rands: field[N, 3(, limbs)].
+    pair_shares: field[..., M, LANES(, limbs)] — this server's value-pair
+    shares over the M tree nodes of the level, any leading batch dims
+    (clients, or clients × dims); r: field[M(, limbs)] shared random
+    vector; rands: field[..., 3(, limbs)] matching the batch dims.
     """
-    x = pair_shares[..., 0] if not field.limb_shape else pair_shares[..., 0, :]
-    kx = pair_shares[..., 1] if not field.limb_shape else pair_shares[..., 1, :]
+    limb = len(field.limb_shape)
+    if limb:
+        x, kx = pair_shares[..., 0, :], pair_shares[..., 1, :]
+    else:
+        x, kx = pair_shares[..., 0], pair_shares[..., 1]
+    m_axis = x.ndim - 1 - limb
     r2 = field.mul(r, r)
-    rb = r[None] if not field.limb_shape else r[None]
-    r_x = field.sum(field.mul(x, rb), axis=1)
-    r2_x = field.sum(field.mul(x, r2[None]), axis=1)
-    r_kx = field.sum(field.mul(kx, rb), axis=1)
-    g = lambda i: (rands[:, i] if not field.limb_shape else rands[:, i, :])
+    r_x = field.sum(field.mul(x, r), axis=m_axis)
+    r2_x = field.sum(field.mul(x, r2), axis=m_axis)
+    r_kx = field.sum(field.mul(kx, r), axis=m_axis)
+    g = lambda i: (rands[..., i, :] if limb else rands[..., i])
     return SketchOutput(
         r_x=r_x, r2_x=r2_x, r_kx=r_kx, rand1=g(0), rand2=g(1), rand3=g(2)
     )
@@ -176,9 +217,13 @@ def sketch_output(field, pair_shares, r, rands) -> SketchOutput:
 
 @partial(jax.jit, static_argnames=("field",))
 def mul_state(field, out: SketchOutput, mac_key, mac_key2, triples) -> mpc.MulStateBatch:
-    """Assemble the three checks per client (ref: mpc.rs:83-141):
-    (1) r_x*r_x - r2_x; (2) k*k - k²; (3) r_x*k - r_kx."""
-    stack = lambda *vs: jnp.stack(vs, axis=1)
+    """Assemble the three checks per batch row (ref: mpc.rs:83-141):
+    (1) r_x*r_x - r2_x; (2) k*k - k²; (3) r_x*k - r_kx.  ``mac_key`` /
+    ``mac_key2`` must be pre-broadcast to ``out.r_x``'s shape."""
+    axis = out.r_x.ndim - len(field.limb_shape)
+    stack = lambda *vs: jnp.stack(
+        [jnp.broadcast_to(v, out.r_x.shape) for v in vs], axis=axis
+    )
     xs = stack(out.r_x, mac_key, out.r_x)
     ys = stack(out.r_x, mac_key, mac_key)
     zs = stack(field.neg(out.r2_x), field.neg(mac_key2), field.neg(out.r_kx))
@@ -210,39 +255,52 @@ def eval_level_full(key: SketchKeyBatch, level: int, field_t, field_u, data_len:
     Walks a fixed ``2^data_len``-slot padded tree: slot i's direction at
     step j is bit ``data_len-1-j`` of i, so slots sharing a prefix hold
     identical (redundantly computed) states and EVERY level advances with
-    the same ``[N, 2^data_len]`` program — one XLA compile per field for
+    the same ``[..., 2^data_len]`` program — one XLA compile per field for
     the whole walk instead of one per level width (the test suite is
     compile-bound; the redundancy is trivial at spec-helper scale).
     Exponential in ``data_len`` by construction: this enumerates all
-    prefixes (a spec/test helper — the server path is the
-    frontier-following sketch state, protocol/rpc.py).  Returns
-    field[N, 2^(level+1), LANES(, limbs)]."""
+    prefixes (a SPEC/TEST helper — the server path is the
+    frontier-following sketch state, protocol/rpc.py) and is therefore
+    guarded to small domains.  Key batch dims are arbitrary (clients, or
+    clients × dims); returns field[..., 2^(level+1), LANES(, limbs)]."""
+    if data_len > 16:
+        raise ValueError(
+            f"eval_level_full enumerates 2^data_len slots; data_len="
+            f"{data_len} > 16 would allocate per-client tensors of "
+            f"{1 << data_len} nodes — use the frontier-following server "
+            "path (rpc.sketch_verify) for production domains"
+        )
     k = key.key
-    N = k.root_seed.shape[0]
+    batch = k.root_seed.shape[:-1]
+    nb = len(batch)
     L = data_len
     M = 1 << L
     st = jax.tree.map(
-        lambda a: jnp.broadcast_to(a[:, None], (N, M) + a.shape[1:]),
+        lambda a: jnp.broadcast_to(
+            a[(Ellipsis, None) + (slice(None),) * (a.ndim - nb)],
+            batch + (M,) + a.shape[nb:],
+        ),
         dpf.eval_init(k),
-    )  # [N, M]
+    )  # [..., M(, 4)]
     slots = jnp.arange(M)
     shares = None
     for j in range(level + 1):
-        cw = tuple(
-            jax.tree.map(lambda a: a[:, None] if a.ndim > 1 else a, c)
-            for c in dpf.level_cw(k, j)
-        )
+        cw = tuple(c[..., None, :] for c in dpf.level_cw(k, j))
         field = field_t if j < data_len - 1 else field_u
-        cwv = (k.cw_val[:, j] if j < data_len - 1 else k.cw_val_last)[:, None]
+        if j < data_len - 1:
+            cwv = k.cw_val[..., j, :]  # [..., LANES]
+        else:
+            cwv = k.cw_val_last  # [..., LANES(, limbs)]
+        cwv = jnp.expand_dims(cwv, axis=nb)  # insert the M axis
         dirs = jnp.broadcast_to(
-            ((slots >> (L - 1 - j)) & 1).astype(bool)[None], (N, M)
+            ((slots >> (L - 1 - j)) & 1).astype(bool), batch + (M,)
         )
         st, shares = dpf.eval_bit(
-            cw, st, dirs, cwv, k.key_idx[:, None], field, LANES
+            cw, st, dirs, cwv, k.key_idx[..., None], field, LANES
         )
     # representative slot of prefix p (level+1 bits): p << (L-1-level)
     idx = jnp.arange(1 << (level + 1)) << (L - 1 - level)
-    return shares[:, idx]
+    return jnp.take(shares, idx, axis=nb)
 
 
 def verify_level(
@@ -257,11 +315,17 @@ def verify_level(
 ) -> np.ndarray:
     """Full two-server sketch verification at one level -> bool[N].
 
-    Chunked over the client axis by ``sketch_batch_size`` (the config knob
-    the reference ships but never parses, src/bin/config.json:9-10)."""
+    Multi-dim key batches ([N, d]) verify per (client, dim); a client
+    passes iff every dim passes.  Chunked over the client axis by
+    ``sketch_batch_size`` (the config knob the reference ships but never
+    parses, src/bin/config.json:9-10).  Spec/test helper like
+    :func:`eval_level_full` (and guarded by its data_len limit)."""
     last = level == data_len - 1
     field = field_u if last else field_t
-    N = np.asarray(sk0.key.root_seed).shape[0]
+    limb = field.limb_shape
+    batch = np.asarray(sk0.key.root_seed).shape[:-1]  # (N,) or (N, d)
+    N = batch[0]
+    extra = batch[1:]
     m = 1 << (level + 1)
     out = np.empty(N, bool)
     for lo in range(0, N, sketch_batch_size):
@@ -273,19 +337,25 @@ def verify_level(
         # program then has one shape for every level (and both servers
         # still derive identical values — same function, same args)
         r_full, rands = shared_r_stream(
-            field, shared_seed, level, 1 << data_len, n_sl
+            field, shared_seed, level, 1 << data_len,
+            n_sl * int(np.prod(extra, initial=1)),
         )
         r = r_full[:m]
+        rands = rands.reshape((n_sl,) + tuple(extra) + (3,) + limb)
         states = []
         for ks in (ks0, ks1):
             pairs = eval_level_full(ks, level, field_t, field_u, data_len)
             o = sketch_output(field, pairs, r, rands)
             if last:
-                trip = jax.tree.map(lambda a: a, ks.triples_last)
+                trip = ks.triples_last
                 mk, mk2 = ks.mac_key_last, ks.mac_key2_last
             else:
-                trip = jax.tree.map(lambda a: a[:, level], ks.triples)
+                trip = jax.tree.map(lambda a: a[..., level, :], ks.triples)
                 mk, mk2 = ks.mac_key, ks.mac_key2
+            if extra:  # broadcast per-client MACs over the dim axis
+                mk = jnp.expand_dims(jnp.asarray(mk), 1)
+                mk2 = jnp.expand_dims(jnp.asarray(mk2), 1)
             states.append(mul_state(field, o, mk, mk2, trip))
-        out[sl] = verify_batch(field, states[0], states[1])
+        ok = verify_batch(field, states[0], states[1])
+        out[sl] = ok if not extra else ok.all(axis=tuple(range(1, ok.ndim)))
     return out

@@ -77,8 +77,8 @@ def test_malformed_value_cw_flagged(rng):
     """A client handing both servers a non-unit payload (additive attack)
     fails check 1 at exactly the tampered level, only for that client."""
     _, sk0, sk1, shared, L = _gen(rng)
-    bad = np.asarray(sk0.key.cw_val).copy()
-    bad[2, 1, 0] = (int(bad[2, 1, 0]) + 5) % FE62.P
+    bad = np.asarray(sk0.key.cw_val).copy()  # [N, d=1, L-1, lanes]
+    bad[2, 0, 1, 0] = (int(bad[2, 0, 1, 0]) + 5) % FE62.P
     j = jnp.asarray(bad)
     sk0b = sk0._replace(key=sk0.key._replace(cw_val=j))
     sk1b = sk1._replace(key=sk1.key._replace(cw_val=j))
@@ -90,8 +90,8 @@ def test_malformed_value_cw_flagged(rng):
 def test_forged_mac_lane_flagged_last_level(rng):
     """Forging the k·x lane breaks check 3 in the F255 last level."""
     _, sk0, sk1, shared, L = _gen(rng)
-    bad = np.asarray(sk0.key.cw_val_last).copy()
-    bad[0, 1, 0] ^= 3
+    bad = np.asarray(sk0.key.cw_val_last).copy()  # [N, d=1, lanes, limbs]
+    bad[0, 0, 1, 0] ^= 3
     j = jnp.asarray(bad)
     ok = sketch.verify_level(
         sk0._replace(key=sk0.key._replace(cw_val_last=j)),
@@ -155,6 +155,126 @@ def test_triple_verify_catches_bad_product(rng):
 BASE_PORT = 39531
 
 
+def _run_rpc_protocol(cfg, k0, k1, sk0, sk1, n, port):
+    async def run():
+        s0 = rpc.CollectorServer(0, cfg)
+        s1 = rpc.CollectorServer(1, cfg)
+        t1 = asyncio.create_task(
+            s1.start("127.0.0.1", port + 10, "127.0.0.1", port + 11)
+        )
+        await asyncio.sleep(0.05)
+        t0 = asyncio.create_task(
+            s0.start("127.0.0.1", port, "127.0.0.1", port + 11)
+        )
+        c0 = await rpc.CollectorClient.connect("127.0.0.1", port)
+        c1 = await rpc.CollectorClient.connect("127.0.0.1", port + 10)
+        await asyncio.gather(t0, t1)
+        lead = RpcLeader(cfg, c0, c1)
+        await asyncio.gather(c0.call("reset"), c1.call("reset"))
+        await lead.upload_keys(k0, k1, sk0, sk1)
+        res = await lead.run(n)
+        return res, s0.alive_keys.copy()
+
+    return asyncio.run(run())
+
+
+def test_multidim_sketch_per_dim_detection(rng):
+    """d=2 sketch: honest clients pass every level; a payload forged in
+    ONE dimension flags exactly that client (per-dim DPFs sharing the
+    client's MAC key — the flagship fuzzy shape)."""
+    N, d, L = 5, 2, 5
+    alpha = rng.integers(0, 2, size=(N, d, L)).astype(bool)
+    seeds = rng.integers(0, 2**32, size=(N, d, 2, 4), dtype=np.uint32)
+    cseed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    shared = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    sk0, sk1 = sketch.gen(seeds, alpha, FE62, F255, cseed)
+    for level in (0, 2, L - 1):
+        assert sketch.verify_level(sk0, sk1, level, FE62, F255, L, shared).all()
+    bad = np.asarray(sk0.key.cw_val).copy()  # [N, d, L-1, lanes]
+    bad[2, 1, 1, 0] = (int(bad[2, 1, 1, 0]) + 5) % FE62.P
+    j = jnp.asarray(bad)
+    sk0b = sk0._replace(key=sk0.key._replace(cw_val=j))
+    sk1b = sk1._replace(key=sk1.key._replace(cw_val=j))
+    ok = sketch.verify_level(sk0b, sk1b, 1, FE62, F255, L, shared)
+    assert not ok[2] and ok[[0, 1, 3, 4]].all()
+
+
+def test_multidim_malicious_e2e_excluded(rng):
+    """Flagship shape end to end: n_dims=2 fuzzy balls with malicious
+    security over the full two-server RPC protocol — a client whose
+    dim-1 sketch payload is forged is excluded from every gated count."""
+    L, n, d = 5, 12, 2
+    pts = np.array([[11, 20]] * 8 + [[25, 3], [2, 9], [30, 30], [7, 18]])
+    pts_bits = np.array(
+        [[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts]
+    )
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 1, rng, engine="np")
+    seeds = rng.integers(0, 2**32, size=(n, d, 2, 4), dtype=np.uint32)
+    cseed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    sk0, sk1 = sketch.gen(seeds, pts_bits, FE62, F255, cseed)
+    bad = np.asarray(sk0.key.cw_val).copy()
+    bad[3, 1, 2, 0] = (int(bad[3, 1, 2, 0]) + 1) % FE62.P
+    j = jnp.asarray(bad)
+    sk0 = sk0._replace(key=sk0.key._replace(cw_val=j))
+    sk1 = sk1._replace(key=sk1.key._replace(cw_val=j))
+
+    cfg = Config(
+        data_len=L, n_dims=d, ball_size=1, addkey_batch_size=12, num_sites=4,
+        threshold=0.5, zipf_exponent=1.03,
+        server0="127.0.0.1:39571", server1="127.0.0.1:39581",
+        distribution="zipf", f_max=64, sketch_batch_size=100_000,
+    )
+    res, alive = _run_rpc_protocol(cfg, k0, k1, sk0, sk1, n, 39571)
+    want_alive = np.ones(n, bool)
+    want_alive[3] = False
+    np.testing.assert_array_equal(alive, want_alive)
+    got = {
+        tuple(int(v) for v in r): int(c)
+        for r, c in zip(res.decode_ints(), res.counts)
+    }
+    # threshold 6: the ball product around (11, 20) survives with the 7
+    # honest clients there; the cheater is excluded from every count
+    assert got and all(c == 7 for c in got.values())
+    assert (11, 20) in got
+
+
+def test_secure_plus_malicious_e2e(rng):
+    """The combined reference-intent deployment: GC+OT secure exchange AND
+    sketch verification in one protocol run — the cheater is excluded and
+    the secure-mode counts match."""
+    L, n = 5, 12
+    pts = np.array([[11]] * 8 + [[25], [2], [50], [60]])
+    pts_bits = np.array(
+        [[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts]
+    )
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 1, rng, engine="np")
+    seeds = rng.integers(0, 2**32, size=(n, 2, 4), dtype=np.uint32)
+    cseed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    sk0, sk1 = sketch.gen(seeds, pts_bits[:, 0, :], FE62, F255, cseed)
+    bad = np.asarray(sk0.key.cw_val).copy()
+    bad[3, 0, 2, 0] = (int(bad[3, 0, 2, 0]) + 1) % FE62.P
+    j = jnp.asarray(bad)
+    sk0 = sk0._replace(key=sk0.key._replace(cw_val=j))
+    sk1 = sk1._replace(key=sk1.key._replace(cw_val=j))
+
+    cfg = Config(
+        data_len=L, n_dims=1, ball_size=1, addkey_batch_size=12, num_sites=4,
+        threshold=0.5, zipf_exponent=1.03,
+        server0="127.0.0.1:39591", server1="127.0.0.1:39601",
+        distribution="zipf", f_max=32, sketch_batch_size=100_000,
+        secure_exchange=True,
+    )
+    res, alive = _run_rpc_protocol(cfg, k0, k1, sk0, sk1, n, 39591)
+    want_alive = np.ones(n, bool)
+    want_alive[3] = False
+    np.testing.assert_array_equal(alive, want_alive)
+    got = {
+        tuple(int(v) for v in r): int(c)
+        for r, c in zip(res.decode_ints(), res.counts)
+    }
+    assert got == {(10,): 7, (11,): 7, (12,): 7}
+
+
 def test_malformed_key_excluded_from_counts(rng):
     # (L, n, f_max, d) match test_secure.py's socket e2e so the trusted
     # crawl kernels compile once for both files
@@ -170,8 +290,8 @@ def test_malformed_key_excluded_from_counts(rng):
     cseed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
     sk0, sk1 = sketch.gen(seeds, alpha, FE62, F255, cseed)
     # client 3's payload forged at level 2 (handed identically to both)
-    bad = np.asarray(sk0.key.cw_val).copy()
-    bad[3, 2, 0] = (int(bad[3, 2, 0]) + 1) % FE62.P
+    bad = np.asarray(sk0.key.cw_val).copy()  # [N, d=1, L-1, lanes]
+    bad[3, 0, 2, 0] = (int(bad[3, 0, 2, 0]) + 1) % FE62.P
     j = jnp.asarray(bad)
     sk0 = sk0._replace(key=sk0.key._replace(cw_val=j))
     sk1 = sk1._replace(key=sk1.key._replace(cw_val=j))
@@ -183,26 +303,7 @@ def test_malformed_key_excluded_from_counts(rng):
         distribution="zipf", f_max=32, sketch_batch_size=100_000,
     )
 
-    async def run():
-        s0 = rpc.CollectorServer(0, cfg)
-        s1 = rpc.CollectorServer(1, cfg)
-        t1 = asyncio.create_task(
-            s1.start("127.0.0.1", BASE_PORT + 10, "127.0.0.1", BASE_PORT + 11)
-        )
-        await asyncio.sleep(0.05)
-        t0 = asyncio.create_task(
-            s0.start("127.0.0.1", BASE_PORT, "127.0.0.1", BASE_PORT + 11)
-        )
-        c0 = await rpc.CollectorClient.connect("127.0.0.1", BASE_PORT)
-        c1 = await rpc.CollectorClient.connect("127.0.0.1", BASE_PORT + 10)
-        await asyncio.gather(t0, t1)
-        lead = RpcLeader(cfg, c0, c1)
-        await asyncio.gather(c0.call("reset"), c1.call("reset"))
-        await lead.upload_keys(k0, k1, sk0, sk1)
-        res = await lead.run(n)
-        return res, s0.alive_keys.copy()
-
-    res, alive = asyncio.run(run())
+    res, alive = _run_rpc_protocol(cfg, k0, k1, sk0, sk1, n, BASE_PORT)
     # the cheater was excluded exactly
     want_alive = np.ones(n, bool)
     want_alive[3] = False
