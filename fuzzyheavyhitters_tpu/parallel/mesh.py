@@ -41,6 +41,15 @@ from ..protocol.collect import EvalState, Frontier
 SERVERS = "servers"
 DATA = "data"
 
+# sharding spec of a party-stacked key batch [2, N, d, 2, ...]
+_KEY_SPEC = IbDcfKeyBatch(
+    key_idx=P(SERVERS, DATA),
+    root_seed=P(SERVERS, DATA),
+    cw_seed=P(SERVERS, DATA),
+    cw_bits=P(SERVERS, DATA),
+    cw_y_bits=P(SERVERS, DATA),
+)
+
 
 def field_psum(field, v, axis_name):
     """Modular psum: sum field elements over a mesh axis without overflow.
@@ -83,14 +92,16 @@ def init_distributed(
     standard env/cluster autodetection (``jax.distributed.initialize``
     semantics).
 
-    Scope, stated honestly: the host-side paths are single-process today —
-    ``MeshRunner.__init__`` device_puts full arrays (multi-process ingest
-    needs per-process local shards via
-    ``jax.make_array_from_process_local_data``), and ``_setup_secure``
-    draws per-process host randomness (multi-process secure mode needs the
-    session seeds / base-OT material agreed from process 0).  Those two
-    seams are the remaining multi-host work; the device programs
-    themselves need no changes.
+    Multi-process host seams (tests/test_mesh_multiprocess.py runs them
+    for real with two processes): ingest via
+    :meth:`MeshRunner.from_process_local` — each process supplies only
+    its own mesh row's key batch, combined with
+    ``jax.make_array_from_process_local_data`` — and secure-mode session
+    material (base-OT seeds, session seed) is agreed from process 0 via
+    ``broadcast_one_to_all``.  NB the mesh transport is a single TRUST
+    domain (the runtime sees both parties' material; use the socket
+    transport, protocol/rpc.py, for two-administrative-domain
+    deployments); multi-host here is the SCALE axis.
     """
     jax.distributed.initialize(
         coordinator_address=coordinator,
@@ -128,38 +139,33 @@ class MeshRunner:
     def __init__(
         self,
         mesh: Mesh,
-        keys0: IbDcfKeyBatch,
-        keys1: IbDcfKeyBatch,
+        keys0: IbDcfKeyBatch | None,
+        keys1: IbDcfKeyBatch | None,
         f_max: int,
         secure_exchange: bool = False,
         min_bucket: int = 1,
+        _global_keys: IbDcfKeyBatch | None = None,
     ):
         self.mesh = mesh
         self.f_max = f_max
         self.min_bucket = min_bucket  # pin >1 only on compile-bound hosts
         self.secure = secure_exchange
-        self.n_dims = keys0.cw_seed.shape[1]
-        self.data_len = keys0.data_len
         self._derived = prg.DERIVED_BITS
-        n = keys0.cw_seed.shape[0]
+        self._key_spec = _KEY_SPEC
+        if _global_keys is not None:  # from_process_local path
+            self.keys = _global_keys
+        else:
+            keys = _stack_parties(keys0, keys1)  # [2, N, d, 2, ...]
+            self.keys = jax.tree.map(
+                lambda a, s: self._host_put(a, s), keys, _KEY_SPEC
+            )
+        n = self.keys.cw_seed.shape[1]
+        self.n_dims = self.keys.cw_seed.shape[2]
+        self.data_len = self.keys.cw_seed.shape[-2]
         assert n % mesh.shape[DATA] == 0, (
             f"client count {n} must divide the data axis {mesh.shape[DATA]}"
         )
-        keys = _stack_parties(keys0, keys1)  # [2, N, d, 2, ...]
-        key_spec = IbDcfKeyBatch(
-            key_idx=P(SERVERS, DATA),
-            root_seed=P(SERVERS, DATA),
-            cw_seed=P(SERVERS, DATA),
-            cw_bits=P(SERVERS, DATA),
-            cw_y_bits=P(SERVERS, DATA),
-        )
-        self._key_spec = key_spec
-        self.keys = jax.tree.map(
-            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), keys, key_spec
-        )
-        self.alive_keys = jax.device_put(
-            jnp.ones((2, n), bool), NamedSharding(mesh, P(SERVERS, DATA))
-        )
+        self.alive_keys = self._host_put(np.ones((2, n), bool), P(SERVERS, DATA))
         self._frontier_spec = Frontier(
             states=EvalState(
                 seed=P(SERVERS, None, DATA),
@@ -182,6 +188,49 @@ class MeshRunner:
         if secure_exchange:
             self._setup_secure()
 
+    def _host_put(self, arr, spec):
+        """Place a host array onto the mesh.  Single-process: device_put.
+        Multi-process: every process holds the same global host value
+        (replicated or agreed-from-process-0 material) and materializes
+        only its addressable shards via ``make_array_from_callback``."""
+        sharding = NamedSharding(self.mesh, spec)
+        arr = np.asarray(arr)
+        if jax.process_count() == 1:
+            return jax.device_put(arr, sharding)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+
+    @classmethod
+    def from_process_local(
+        cls,
+        mesh: Mesh,
+        my_keys: IbDcfKeyBatch,
+        f_max: int,
+        secure_exchange: bool = False,
+        min_bucket: int = 1,
+    ) -> "MeshRunner":
+        """Multi-process construction for the two-host deployment shape
+        (``configs/amazon.json``): process p hosts mesh row p (party p's
+        chips) and supplies ONLY its own party's key batch — the global
+        party-stacked arrays are assembled from the process-local rows
+        via ``jax.make_array_from_process_local_data``, so no process
+        ever materializes the peer party's keys on its host."""
+        assert jax.process_count() == 2, "from_process_local is the 2-host shape"
+        local = jax.tree.map(lambda a: np.asarray(a)[None], my_keys)  # [1, N, ..]
+        keys = jax.tree.map(
+            lambda a, s: jax.make_array_from_process_local_data(
+                NamedSharding(mesh, s), a
+            ),
+            local,
+            _KEY_SPEC,
+        )
+        return cls(
+            mesh, None, None, f_max,
+            secure_exchange=secure_exchange, min_bucket=min_bucket,
+            _global_keys=keys,
+        )
+
     def _setup_secure(self):
         """Host-side base-OT setup for the on-mesh 2PC, one session per
         garbling DIRECTION so the leader can alternate the garbler per
@@ -192,28 +241,40 @@ class MeshRunner:
         mesh-row slot; the unused slots are zeros (SPMD runs both roles on
         both parties and discards the wrong-role half — branchless, like
         any 2-way-masked collective)."""
-        put = lambda a, spec: jax.device_put(
-            a, NamedSharding(self.mesh, spec)
-        )
         z = np.zeros((otext.KAPPA, 4), np.uint32)
-        self._sec = {}
+        host_mats = []
         for g in (0, 1):
             s_bits = otext.fresh_s_bits()
             seeds0, seeds1, chosen = baseot.exchange(s_bits)
+            host_mats.append((s_bits, seeds0, seeds1, chosen))
+        sec_seed = np.frombuffer(_secrets.token_bytes(16), "<u4").copy()
+        if jax.process_count() > 1:
+            # session material must be identical everywhere: agree from
+            # process 0 (single trust domain — see init_distributed note)
+            from jax.experimental import multihost_utils
+
+            host_mats, sec_seed = multihost_utils.broadcast_one_to_all(
+                (host_mats, sec_seed)
+            )
+            host_mats = jax.tree.map(np.asarray, host_mats)
+            sec_seed = np.asarray(sec_seed)
+        self._sec = {}
+        for g, (s_bits, seeds0, seeds1, chosen) in enumerate(host_mats):
+            s_bits = np.asarray(s_bits)
             zb = np.zeros_like(s_bits)
             rows = lambda a_g, a_e: np.stack([a_g, a_e] if g == 0 else [a_e, a_g])
             self._sec[g] = {
-                "s_bits": put(rows(s_bits, zb), P(SERVERS, None)),
-                "seeds_main": put(
+                "s_bits": self._host_put(rows(s_bits, zb), P(SERVERS, None)),
+                "seeds_main": self._host_put(
                     rows(chosen, seeds0).astype(np.uint32), P(SERVERS, None, None)
                 ),
-                "seeds_aux": put(
+                "seeds_aux": self._host_put(
                     rows(z, seeds1).astype(np.uint32), P(SERVERS, None, None)
                 ),
                 "blocks": 0,  # column-stream block offset (lockstep)
                 "sent": 0,  # pad-tweak index base
             }
-        self._sec_seed = np.frombuffer(_secrets.token_bytes(16), "<u4").copy()
+        self._sec_seed = sec_seed
         self._crawl_ctr = 0
 
     def _build_kernels(self):
@@ -367,9 +428,17 @@ class MeshRunner:
                 field, vals.reshape((F_, C, Nl) + limb), wgt
             )
             shares = field_psum(field, shares, DATA)
-            return shares[None], jax.tree.map(lambda a: a[None], children)
+            # exchange both parties' share rows so the output is REPLICATED
+            # [2, F, C(, limbs)] — the leader-side reconstruction then reads
+            # a fully-addressable array on every process.  One-hot expand +
+            # psum (each slot has exactly one contributor) rather than
+            # all_gather: psum's replication is statically certified.
+            party_row = jax.lax.axis_index(SERVERS)
+            expand = jnp.zeros((2,) + shares.shape, shares.dtype)
+            expand = expand.at[party_row].set(shares)
+            allsh = jax.lax.psum(expand, SERVERS)
+            return allsh, jax.tree.map(lambda a: a[None], children)
 
-        out_spec = P(SERVERS, None, None, *([None] if limb else []))
         fn = jax.jit(
             jax.shard_map(
                 body,
@@ -379,7 +448,7 @@ class MeshRunner:
                     P(SERVERS, None, None), P(SERVERS, None, None),
                     P(SERVERS, None), P(SERVERS, None), P(), P(), P(),
                 ),
-                out_specs=(out_spec, self._child_spec),
+                out_specs=(P(), self._child_spec),
             )
         )
         return fn
@@ -413,9 +482,7 @@ class MeshRunner:
         gseed = secure.derive_seed(self._sec_seed, 1, level, self._crawl_ctr)
         bseed = secure.derive_seed(self._sec_seed, 2, level, self._crawl_ctr)
         z = np.zeros(4, np.uint32)
-        put = lambda a: jax.device_put(
-            np.stack([a, z]), NamedSharding(self.mesh, P(SERVERS, None))
-        )
+        put = lambda a: self._host_put(np.stack([a, z]), P(SERVERS, None))
         # static per-call shapes -> deterministic stream consumption; the
         # GC/OT batch is sized to the CURRENT frontier bucket, not f_max
         n_local = self.keys.cw_seed.shape[1] // self.mesh.shape[DATA]
