@@ -12,19 +12,17 @@ throughput vs the reference's single-threaded AES-NI baseline
   data_len=512 trusted-mode crawl (expand -> exchange -> count ->
   threshold -> prune/advance per level) over N clients on one chip;
 - ``secure_crawl``: the same loop with the REAL GC+OT data plane between
-  two in-process collector servers over localhost sockets (e2e, so a
-  lower bound through the remote-chip tunnel);
-- ``upload``: 100k-key pipelined control-plane ingest.
-
-HBM plan at N = 1M clients (north star: 1M clients < 10 s on v5e-8): the
-frontier state is ``EvalState[F, N, d, 2]`` = seeds u32[...,4] + 2 bool
-tensors ≈ 18 B per (node, client, dim, side).  At d=1, F=64:
-64·1e6·1·2·18 B ≈ 2.3 GB, and the transient packed-bit tensor is
-F·N·4 B = 256 MB — both fit a single v5e chip's 16 GB HBM.  Key material
-is L·18 B + 16 B per (client, dim, side): at L=512 ≈ 9.2 KB/key·side,
-i.e. ~18.5 GB for 1M clients' full batches — sharded over the 8-chip data
-axis (parallel/mesh.py) that is ~2.3 GB/chip.  No component scales with
-2^d beyond the [F, 2^d] count tensor.
+  two in-process collector servers over localhost sockets (e2e — through
+  the remote-chip tunnel this is floored by ~0.12 s per device<->host
+  round trip, see ``secure_device`` for the deployment-shape number);
+- ``secure_device``: the whole per-level 2PC as one on-chip program (the
+  1-chip stand-in for the 2-chip mesh deployment);
+- ``hbm``: the 1M-client HBM plan VALIDATED by allocation — the L=512
+  key batch at the largest bench N actually lives on the chip, 3 levels
+  run, and bytes/client are measured, not derived;
+- ``hash_margin``: measured garbling cost at ChaCha rounds 8/12/20 (the
+  margin note in ops/prg.py cites these);
+- ``upload``: 1M-key control-plane ingest through the rolling window.
 """
 
 import json
@@ -42,6 +40,15 @@ BASELINE_US_PER_KEY = {64: None, 128: 25.92, 256: 50.47, 512: 99.97, 1024: 216.2
 BASELINE_KEYS_PER_SEC = 1e6 / 99.97  # ibDCFbench.csv:5 (data_len=512)
 # reference per-key wire bytes (bincode), ibDCFbench.csv
 BASELINE_KEY_BYTES = {128: 2585, 256: 5145, 512: 10265, 1024: 20505}
+
+
+def _keygen_engine() -> str:
+    """Fused Pallas kernel on a real chip; the host NumPy mirror elsewhere
+    (no Mosaic on XLA:CPU — and the jax scan engine compiles pathologically
+    there, see tests/conftest.py)."""
+    import jax
+
+    return "pallas" if jax.default_backend() != "cpu" else "np"
 
 
 def _key_wire_bytes(k0) -> int:
@@ -90,7 +97,7 @@ def _throughput(jnp, gen, seeds_d, alpha_d, side_d, n, iters=20, trials=3):
     return n / best, k0
 
 
-def bench_keygen(jax, jnp, ibdcf, rng, sweep=(64, 256, 512, 1024), n=8192):
+def bench_keygen(jax, jnp, ibdcf, rng, sweep=(64, 128, 256, 512, 1024), n=8192):
     from fuzzyheavyhitters_tpu.ops.keygen_pallas import gen_pair_pallas
 
     rows = {}
@@ -123,6 +130,18 @@ def bench_keygen(jax, jnp, ibdcf, rng, sweep=(64, 256, 512, 1024), n=8192):
     return headline, rows
 
 
+def write_keygen_csv(rows: dict, n: int, path: str = "ibDCFbench_tpu.csv"):
+    """Emit the sweep in the shape of the reference's one shipped benchmark
+    artifact (ibDCFbench.rs:57-68 -> ibDCFbench.csv: string_length,
+    number_keys, time, avg_time, size)."""
+    with open(path, "w") as f:
+        f.write("string_length,number_keys,time,avg_time,size\n")
+        for L in sorted(rows):
+            r = rows[L]
+            avg = 1.0 / r["keys_per_sec"]
+            f.write(f"{L},{n},{avg * n},{avg},{r['key_bytes']}\n")
+
+
 def bench_crawl(ibdcf, driver, rng, n=131072, L=512, f_max=64):
     """Server hot loop: full L-level trusted-mode crawl on one chip.
 
@@ -146,7 +165,7 @@ def bench_crawl(ibdcf, driver, rng, n=131072, L=512, f_max=64):
     pts_bits = sites[rng.integers(0, n_sites, size=n)]
     # keygen on the chip (the fused kernel): host NumPy keygen for 512-bit
     # interval pairs at this N takes hours on a 1-core host
-    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng, engine="pallas")
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng, engine=_keygen_engine())
 
     import jax
     import jax.numpy as jnp
@@ -266,7 +285,7 @@ def bench_secure(n=1024, L=12, port=39831):
     pts_bits = (
         ((pts[:, None, None] >> np.arange(L - 1, -1, -1)) & 1) > 0
     )  # [n, 1, L] MSB-first
-    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng, engine="pallas")
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng, engine=_keygen_engine())
 
     cfg = Config(
         data_len=L, n_dims=1, ball_size=2, addkey_batch_size=1024,
@@ -333,7 +352,7 @@ def bench_secure_device(n=1024, L=12, f_bucket=16):
     sites = rng.integers(0, 1 << L, size=8)
     pts = sites[rng.integers(0, 8, size=n)]
     pts_bits = ((pts[:, None, None] >> np.arange(L - 1, -1, -1)) & 1) > 0
-    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng, engine="pallas")
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng, engine=_keygen_engine())
     d = 1
     C, S = 1 << d, 2 * d
     B = f_bucket * C * n
@@ -431,11 +450,92 @@ def bench_secure_device(n=1024, L=12, f_bucket=16):
     }
 
 
-def bench_upload(n=100_000, L=16, batch=1000, port=39731):
-    """100k-key ingest benchmark: leader -> two servers over localhost TCP
-    with the id'd pipelined framing (ref: leader.rs:340-364's 1000
-    in-flight batches).  Host-side only — add_keys appends buffers; the
-    device sees keys once at tree_init."""
+def bench_hbm(n=196608, L=512, levels=3, f_max=64):
+    """HBM scale validation: ACTUALLY allocate the L=512 key batch for the
+    largest N this bench holds on one chip (both servers' batches — the
+    1-chip driver shape, so one server's real footprint is half), run 3
+    crawl levels on it, and report measured bytes — replacing the round-3
+    plan that was arithmetic, not a measurement."""
+    import jax
+    import jax.numpy as jnp
+
+    from fuzzyheavyhitters_tpu.ops import ibdcf
+    from fuzzyheavyhitters_tpu.protocol import driver
+
+    rng = np.random.default_rng(0)
+    sites = rng.integers(0, 2, size=(4, 1, L)).astype(bool)
+    pts_bits = sites[rng.integers(0, 4, size=n)]
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng, engine=_keygen_engine())
+    jax.block_until_ready(k0.cw_seed)
+    key_bytes = sum(
+        leaf.nbytes for k in (k0, k1) for leaf in jax.tree.leaves(k)
+    )
+    per_client_per_server = key_bytes / 2 / n
+    s0, s1 = driver.make_servers(k0, k1)
+    lead = driver.Leader(s0, s1, n_dims=1, data_len=L, f_max=f_max)
+    lead.tree_init()
+    for lvl in range(levels):  # warm (compiles the small-bucket shapes)
+        lead.run_level(lvl, nreqs=n, threshold=0.05)
+    lead.tree_init()
+    t0 = time.perf_counter()
+    for lvl in range(levels):
+        n_alive = lead.run_level(lvl, nreqs=n, threshold=0.05)
+    dt = time.perf_counter() - t0
+    assert n_alive >= 1
+    # one v5e chip has 16 GB; leave 15% headroom for transients
+    max_n_one_server = int(16e9 * 0.85 / per_client_per_server)
+    return {
+        "n_clients_allocated": n,
+        "levels_run": levels,
+        "key_gbytes_on_chip_both_servers": round(key_bytes / 1e9, 2),
+        "measured_key_bytes_per_client_per_server": round(
+            per_client_per_server, 1
+        ),
+        "ms_per_level_e2e": round(dt / levels * 1000, 2),
+        "projected_max_clients_one_chip_16gb": max_n_one_server,
+        "chips_for_1m_clients_keys": round(1e6 / max_n_one_server, 2),
+    }
+
+
+def bench_hash_margin(B=131072, S=2):
+    """Measured cost of the ChaCha round count in the GC hash role (the
+    correlation-robust hash of garbling; ops/prg.py N_ROUNDS note): one
+    garble of a [B, S] equality batch at 8 / 12 / 20 rounds."""
+    import secrets as pysecrets
+
+    import jax
+    import jax.numpy as jnp
+
+    from fuzzyheavyhitters_tpu.ops import gc, prg
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 2, size=(B, S)).astype(bool))
+    y0 = jnp.asarray(rng.integers(0, 2**32, size=(B, S, 4), dtype=np.uint32))
+    s_block = jnp.asarray(
+        rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    )
+    seed = jnp.asarray(np.frombuffer(pysecrets.token_bytes(16), "<u4").copy())
+    out = {"gc_batch": B * S}
+    for rounds in (8, 12, 20):
+        prg.N_ROUNDS = rounds
+        jax.clear_caches()  # N_ROUNDS is read at trace time
+        best = _steady_state_seconds(
+            lambda: gc.garble_equality_delta(s_block, y0, seed, x)[0].tables,
+            lambda outs: int(sum(jnp.sum(o[0, 0]) for o in outs)),
+            lambda o: int(jnp.sum(o[0, 0])),
+            iters=32,
+        )
+        out[f"garble_ms_rounds_{rounds}"] = round(best * 1000, 3)
+    prg.N_ROUNDS = 8
+    jax.clear_caches()
+    return out
+
+
+def bench_upload(n=1_000_000, L=16, batch=4000, port=39731):
+    """1M-key ingest benchmark: leader -> two servers over localhost TCP
+    with the ROLLING upload window (leader_rpc.upload_keys; ref:
+    leader.rs:340-364's 1000 in-flight batches).  Host-side only —
+    add_keys appends buffers; the device sees keys once at tree_init."""
     import asyncio
 
     from fuzzyheavyhitters_tpu.ops import ibdcf
@@ -447,6 +547,12 @@ def bench_upload(n=100_000, L=16, batch=1000, port=39731):
     alpha = rng.integers(0, 2, size=(n, 1, 2, L)).astype(bool)
     seeds = rng.integers(0, 2**32, size=(n, 1, 2, 2, 4), dtype=np.uint32)
     side = np.broadcast_to(np.array([True, False]), (n, 1, 2))
+    # HOST keygen on purpose: this bench measures control-plane ingest, and
+    # the keys must be host-resident contiguous buffers (client-axis chunk
+    # slices then pickle zero-copy).  Measured: chip keygen + tunnel fetch
+    # yields NON-contiguous leaves whose chunks copy on every pickle
+    # (368 MB/s vs 2.8 GB/s), and at L=16 the fetch alone dwarfs host
+    # keygen time.
     k0, k1 = ibdcf.gen_pair_np(seeds, alpha, side)
 
     cfg = Config(
@@ -525,10 +631,25 @@ def main():
         "print(json.dumps(bench.bench_secure_device()))",
         timeout_s=540,
     )
+    hbm = _subprocess_metric(
+        "import json, bench;"
+        "print(json.dumps(bench.bench_hbm()))",
+        timeout_s=540,
+    )
+    hash_margin = _subprocess_metric(
+        "import json, bench;"
+        "print(json.dumps(bench.bench_hash_margin()))",
+        timeout_s=540,
+    )
+    upload = _subprocess_metric(
+        "import json, bench;"
+        "print(json.dumps(bench.bench_upload()))",
+        timeout_s=540,
+    )
     try:
-        upload = bench_upload()
-    except Exception as e:
-        upload = {"error": f"{type(e).__name__}: {e}"[:200]}
+        write_keygen_csv(sweep, 8192)
+    except Exception:
+        pass
 
     print(
         json.dumps(
@@ -543,6 +664,8 @@ def main():
                     "crawl": crawl,
                     "secure_crawl": secure,
                     "secure_device": secure_device,
+                    "hbm": hbm,
+                    "hash_margin": hash_margin,
                     "upload": upload,
                 },
             }

@@ -78,9 +78,25 @@ def sample_points(cfg, nreqs: int, rng) -> np.ndarray:
 
 
 async def amain() -> None:
+    import contextlib
+
+    import jax
+
     cfg, _, nreqs = configmod.get_args("Leader", get_n_reqs=True)
     rng = np.random.default_rng()
 
+    # backend knob, like bin/server.py: "cpu" pins every uncommitted array
+    # op (keygen here) onto the host backend
+    ctx = (
+        jax.default_device(jax.devices("cpu")[0])
+        if cfg.backend == "cpu"
+        else contextlib.nullcontext()
+    )
+    with ctx:
+        await _run(cfg, nreqs, rng)
+
+
+async def _run(cfg, nreqs: int, rng) -> None:
     print("Generating keys...")
     keygen_report(cfg, rng)
 

@@ -27,7 +27,18 @@ Semantics preserved from the reference (pinned by tests/oracle.py):
 Security note: fixed-key ChaCha here plays the role fixed-key AES plays in
 the reference — a correlation-robust hash for FSS (Guo et al. 2020 model).
 ``N_ROUNDS = 8`` matches the margin philosophy of the reference's 10-round
-fixed-key AES; raise to 12/20 for standard-cipher margins at ~1.5x/2.5x cost.
+fixed-key AES (a reduced-round fixed-key cipher as CR hash): the best
+public distinguisher on ChaCha is on 7 rounds, so 8 keeps a one-round
+margin in a model where the adversary does not even control the key.
+Measured cost of more margin (bench.bench_hash_margin, v5e, BENCH_r04):
+in the GC/OT hash role garbling is bandwidth-bound, so 12/20 rounds cost
+only +3% / +6% (18.7 -> 19.3 / 19.8 ms per 262144-wire garble) — an
+operator wanting standard-cipher margins can raise ``N_ROUNDS`` to 20
+nearly free there; the FSS keygen/expand kernels are cipher-bound and pay
+~linearly (~1.5x/2.5x), which is why 8 stays the default for the PRG
+role.  ``N_ROUNDS`` is read at trace time (one global; all roles move
+together — a per-role split is deliberate non-complexity until someone
+needs it).
 """
 
 from __future__ import annotations

@@ -51,9 +51,12 @@ class RpcLeader:
         sketch0=None,
         sketch1=None,
     ):
-        """Batched async key upload (ref: leader.rs:340-364: addkey batches
-        with bounded in-flight concurrency).  Optional sketch key batches
-        ride in the same requests (malicious-secure mode)."""
+        """Batched async key upload with a ROLLING in-flight window (ref:
+        leader.rs:340-364: 1000 addkey batches in flight, refilled as each
+        completes — not drained in bursts: a stop-and-wait gather leaves
+        the pipe empty while the slowest request of each burst finishes).
+        Optional sketch key batches ride in the same requests
+        (malicious-secure mode)."""
         n = np.asarray(keys0.cw_seed).shape[0]
         bs = max(1, self.cfg.addkey_batch_size)
         self.has_sketch = sketch0 is not None
@@ -63,25 +66,24 @@ class RpcLeader:
                 return None
             return [np.asarray(x)[sl] for x in jax.tree.leaves(sk)]
 
-        pending = []
+        window = 256
+        sem = asyncio.Semaphore(window)
+
+        async def send_one(client, keys, sketch, sl):
+            # the request dict is built INSIDE the window so at most
+            # ``window`` chunks are materialized/pickled at a time
+            async with sem:
+                await client.call(
+                    "add_keys",
+                    {"keys": _key_chunk(keys, sl), "sketch": sk_chunk(sketch, sl)},
+                )
+
+        tasks = []
         for lo in range(0, n, bs):
             sl = slice(lo, min(lo + bs, n))
-            pending.append(self.c0.call(
-                "add_keys",
-                {"keys": _key_chunk(keys0, sl), "sketch": sk_chunk(sketch0, sl)},
-            ))
-            pending.append(self.c1.call(
-                "add_keys",
-                {"keys": _key_chunk(keys1, sl), "sketch": sk_chunk(sketch1, sl)},
-            ))
-            # bounded in-flight window; the id'd framing pipelines all of
-            # these on the two connections (ref: 1000 in flight,
-            # leader.rs:342)
-            if len(pending) >= 128:
-                await asyncio.gather(*pending)
-                pending = []
-        if pending:
-            await asyncio.gather(*pending)
+            tasks.append(send_one(self.c0, keys0, sketch0, sl))
+            tasks.append(send_one(self.c1, keys1, sketch1, sl))
+        await asyncio.gather(*tasks)
 
     async def run(self, nreqs: int) -> CrawlResult:
         cfg = self.cfg

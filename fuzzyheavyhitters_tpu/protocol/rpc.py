@@ -567,8 +567,14 @@ class CollectorServer:
             try:
                 async with write_lock:
                     await _send(writer, (req_id, resp))
-            except (ConnectionResetError, BrokenPipeError, RuntimeError):
+            except (ConnectionResetError, BrokenPipeError):
                 pass  # leader gone; the work itself must still have finished
+            except RuntimeError:
+                # asyncio raises RuntimeError for writes on a closing
+                # transport — swallow only that case; anything else would
+                # silently strand the leader awaiting this req_id
+                if not writer.is_closing():
+                    raise
 
         tasks = set()
         try:
@@ -598,10 +604,20 @@ class CollectorServer:
             # loop needs no wall-clock guess that could misfire on a LIVE
             # peer running legitimately long verbs.
             pending = set(tasks)
+            deadline = time.monotonic() + 1800  # generous overall backstop
             while pending:
-                _, pending = await asyncio.wait(pending, timeout=30)
+                done, pending = await asyncio.wait(pending, timeout=30)
+                if done:  # progress: push the backstop out again
+                    deadline = time.monotonic() + 1800
                 if pending and (
-                    self._peer_writer is None or self._peer_writer.is_closing()
+                    self._peer_writer is None
+                    or self._peer_writer.is_closing()
+                    or time.monotonic() > deadline
+                    # the backstop covers what keepalive cannot: a verb
+                    # blocked while the peer data plane stays OPEN (e.g. a
+                    # desynchronized _swap after a peer-side verb error) —
+                    # 30 min without a single task completing is not a
+                    # legitimate long verb, it is a wedged handler
                 ):
                     for t in pending:
                         t.cancel()

@@ -43,7 +43,15 @@ def sample_start_locations(
     Uses the native streaming reservoir sampler (one pass, O(k) memory —
     the multi-GB-CSV regime the reference's Rust loaders run in,
     fuzzyheavyhitters_tpu/native/) when the toolchain allows, else an
-    in-memory NumPy fallback."""
+    in-memory NumPy fallback.
+
+    Reproducibility caveat: a fixed ``seed`` pins the sample WITHIN one
+    environment, but the two paths are different algorithms (xoshiro
+    reservoir vs ``rng.choice``), so the same seed yields different
+    samples depending on whether the native library built.  Both are
+    uniform without replacement; pin the environment (or force the
+    fallback by removing the .so) when cross-machine reproducibility of
+    the exact sample matters."""
     from .. import native
 
     if seed is None:  # stay random per call, matching the NumPy fallback

@@ -1,0 +1,102 @@
+"""Process-level end-to-end smoke test: the README run shape.
+
+Launches ``bin/server.py`` twice and ``bin/leader.py`` as REAL OS
+processes on a rides-distribution config (the flagship i16 lat/lon
+workload), then asserts the heavy-hitter CSV the leader wrote matches
+the in-process driver oracle on the same deterministic client points.
+The binaries are otherwise the one surface no test executes
+(ref: README.md:38-60 run shape)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import jax
+
+from fuzzyheavyhitters_tpu.ops import ibdcf
+from fuzzyheavyhitters_tpu.protocol import driver
+from fuzzyheavyhitters_tpu.utils import bits as bitutils
+from fuzzyheavyhitters_tpu.workloads import rides
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_REQS = 32
+PORT = 39701
+CFG = {
+    "data_len": 16,
+    "n_dims": 2,
+    "ball_size": 2,
+    "addkey_batch_size": 16,
+    "num_sites": 4,
+    "threshold": 0.06,
+    "zipf_exponent": 1.03,
+    "server0": f"127.0.0.1:{PORT}",
+    "server1": f"127.0.0.1:{PORT + 10}",
+    "distribution": "rides",
+    "f_max": 512,
+    "backend": "cpu",
+}
+
+
+def _expected_csv(tmp_path):
+    """Oracle: the colocated driver on the same deterministic points
+    (tmp cwd has no RideAustin CSV -> the seed-42 synthetic sampler,
+    exactly what the leader binary will sample)."""
+    coords = rides.load_or_synthesize_locations(
+        str(tmp_path / "nonexistent.csv"), N_REQS, seed=42
+    )
+    pts_bits = np.stack(
+        [
+            np.stack([bitutils.i16_to_ob_bits(int(v)) for v in row])
+            for row in coords
+        ]
+    )
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, CFG["ball_size"], np.random.default_rng(5), engine="np")
+    with jax.default_device(jax.devices("cpu")[0]):
+        s0, s1 = driver.make_servers(k0, k1)
+        lead = driver.Leader(
+            s0, s1, n_dims=2, data_len=16, f_max=CFG["f_max"]
+        )
+        res = lead.run(nreqs=N_REQS, threshold=CFG["threshold"])
+    assert res.paths.shape[0] >= 1  # non-degenerate scenario
+    out = tmp_path / "expected.csv"
+    rides.save_heavy_hitters(res.paths, str(out))
+    return out.read_text()
+
+
+def test_binaries_end_to_end(tmp_path):
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps(CFG))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_backend_optimization_level=1"
+    ).strip()
+
+    def spawn(mod, *args):
+        return subprocess.Popen(
+            [sys.executable, "-m", mod, "--config", str(cfg_path), *args],
+            cwd=tmp_path, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+
+    s1 = spawn("fuzzyheavyhitters_tpu.bin.server", "--server_id", "1")
+    s0 = spawn("fuzzyheavyhitters_tpu.bin.server", "--server_id", "0")
+    lead = None
+    try:
+        lead = spawn("fuzzyheavyhitters_tpu.bin.leader", "-n", str(N_REQS))
+        out, _ = lead.communicate(timeout=540)
+        assert lead.returncode == 0, f"leader failed:\n{out[-4000:]}"
+        assert "Crawl done" in out
+        csv_path = tmp_path / "data" / "ride_heavy_hitters.csv"
+        assert csv_path.exists(), out[-2000:]
+        got = csv_path.read_text()
+    finally:
+        for p in (s0, s1, lead):
+            if p is not None and p.poll() is None:
+                p.kill()
+    want = _expected_csv(tmp_path)
+    assert got == want
