@@ -381,8 +381,8 @@ def bench_secure_device(n=1024, L=12, f_bucket=16):
 
         @jax.jit
         def run(keys0, fr0, keys1, fr1, lvl):
-            p0, ch0 = collect._expand_share_bits_jit(keys0, fr0, lvl, derived)
-            p1, ch1 = collect._expand_share_bits_jit(keys1, fr1, lvl, derived)
+            p0, _ = collect.expand_share_bits(keys0, fr0, lvl, want_children=False)
+            p1, _ = collect.expand_share_bits(keys1, fr1, lvl, want_children=False)
             flat0 = secure.child_strings(p0, d).reshape(B, S)  # garbler x
             flat1 = secure.child_strings(p1, d).reshape(B, S)  # evaluator y
             off = jnp.uint32(0)

@@ -9,13 +9,14 @@ layout family (ops/keygen_pallas.py: state index spread over (row,
 sublane, lane), cipher words as [R_BLK, 8, LANES] vregs).
 
 Round-4 measured status (v5e, B = 1M states): the kernel body beats the
-XLA level (~5 ms vs ~16 ms) but the word-planar glue — [B, 4] seed
-transposes in and two child-seed transposes out — costs ~25 ms, so the
-end-to-end call LOSES to XLA (~37 ms) and ``collect.EXPAND_PALLAS``
-defaults False.  The glue-free variant (slice the minor seed axis
-in-kernel) hangs the Mosaic compiler.  Flipping the default requires
-keeping frontier seeds word-planar across the crawl; kept in-tree,
-bit-exact and parity-tested, as that fast path's kernel.
+XLA level (~5 ms vs ~16 ms), but interleaved ``[B, 4]`` seeds need
+word-planar transposes in and out costing ~25 ms — so the production
+path is :func:`expand_flat_planar`, with frontier seeds kept WORD-PLANAR
+``[4, ...]`` across the whole crawl (protocol/collect.py's planar
+engine): every layout step is a reshape, never a transpose.  The
+interleaved :func:`expand_flat` survives only for its parity test; the
+in-kernel minor-axis-slice variant (no planar state at all) hangs the
+Mosaic compiler and is not used.
 
 Scope: a pure flat map over B states — the caller keeps the correction-
 word broadcast over nodes, reshapes, and the share-bit packing in XLA
@@ -70,23 +71,29 @@ def _kernel(derived_bits: bool,
     oyr_ref[...] = y_r ^ (t & cwyr_ref[...]) ^ y
 
 
-@partial(jax.jit, static_argnames=("derived_bits",))
-def expand_flat(seed, t, y, cw_seed, cwb_l, cwb_r, cwy_l, cwy_r,
-                derived_bits: bool):
-    """Expand B flat states into both children.
+def _padded_rows(B: int) -> tuple[int, int]:
+    group = SUB * LANES
+    pad = (-B) % (group * R_BLK)
+    return B + pad, (B + pad) // group
 
-    seed/cw_seed: u32[B, 4]; t, y, cwb_l/r, cwy_l/r: bool[B].
-    Returns (seed_l, seed_r u32[B, 4], bit_l, bit_r, y_l, y_r bool[B]) —
-    the per-direction outputs of collect's expand recurrence (child seed
-    already t-corrected, y accumulated along the path).
+
+@partial(jax.jit, static_argnames=("derived_bits",))
+def expand_flat_planar(seed_p, t, y, cws_p, cwb_l, cwb_r, cwy_l, cwy_r,
+                       derived_bits: bool):
+    """Expand B flat states into both children, word-planar operands.
+
+    seed_p/cws_p: u32[4, B] (word-planar); t, y, cwb_l/r, cwy_l/r:
+    bool/u32[B].  Returns (seed_l, seed_r u32[4, B] planar, bit_l, bit_r,
+    y_l, y_r bool[B]) — the per-direction outputs of collect's expand
+    recurrence (child seed already t-corrected, y accumulated along the
+    path).  All layout work is reshape-only: the caller keeps seeds
+    planar across the crawl, so no transpose ever materializes.
     """
     from jax.experimental import pallas as pl
 
-    B = seed.shape[0]
-    group = SUB * LANES
-    pad = (-B) % (group * R_BLK)
-    bp = B + pad
-    rows = bp // group
+    B = seed_p.shape[1]
+    bp, rows = _padded_rows(B)
+    pad = bp - B
 
     def flags(a):
         a = jnp.asarray(a, jnp.uint32)
@@ -94,11 +101,11 @@ def expand_flat(seed, t, y, cw_seed, cwb_l, cwb_r, cwy_l, cwy_r,
             a = jnp.concatenate([a, jnp.zeros((pad,), jnp.uint32)])
         return a.reshape(rows, SUB, LANES)
 
-    def words(a):
+    def words(a):  # u32[4, B] -> [4, rows, SUB, LANES], reshape only
         a = jnp.asarray(a, jnp.uint32)
         if pad:
-            a = jnp.concatenate([a, jnp.zeros((pad, 4), jnp.uint32)])
-        return jnp.transpose(a.reshape(rows, SUB, LANES, 4), (3, 0, 1, 2))
+            a = jnp.concatenate([a, jnp.zeros((4, pad), jnp.uint32)], axis=1)
+        return a.reshape(4, rows, SUB, LANES)
 
     z = np.int32(0)
     spec4 = pl.BlockSpec((4, R_BLK, SUB, LANES), lambda j: (z, j, z, z))
@@ -111,8 +118,22 @@ def expand_flat(seed, t, y, cw_seed, cwb_l, cwb_r, cwy_l, cwy_r,
         in_specs=[spec4, spec1, spec1, spec4, spec1, spec1, spec1, spec1],
         out_specs=[spec4, spec4, spec1, spec1, spec1, spec1],
         out_shape=[s4, s4, s1, s1, s1, s1],
-    )(words(seed), flags(t), flags(y), words(cw_seed),
+    )(words(seed_p), flags(t), flags(y), words(cws_p),
       flags(cwb_l), flags(cwb_r), flags(cwy_l), flags(cwy_r))
-    unw = lambda a: jnp.transpose(a, (1, 2, 3, 0)).reshape(bp, 4)[:B]
+    unw = lambda a: a.reshape(4, bp)[:, :B]
     unf = lambda a: a.reshape(bp)[:B] != 0
     return unw(sl), unw(sr), unf(bl), unf(br), unf(yl), unf(yr)
+
+
+@partial(jax.jit, static_argnames=("derived_bits",))
+def expand_flat(seed, t, y, cw_seed, cwb_l, cwb_r, cwy_l, cwy_r,
+                derived_bits: bool):
+    """Interleaved-layout entry point ([B, 4] seeds): transposes to the
+    planar form and back.  Measured SLOWER than the XLA expand end to end
+    (the transposes dominate) — kept for the bit-exactness parity test;
+    production uses :func:`expand_flat_planar`."""
+    tr = lambda a: jnp.transpose(jnp.asarray(a, jnp.uint32), (1, 0))
+    sl, sr, bl, br, yl, yr = expand_flat_planar(
+        tr(seed), t, y, tr(cw_seed), cwb_l, cwb_r, cwy_l, cwy_r, derived_bits
+    )
+    return tr(sl), tr(sr), bl, br, yl, yr

@@ -288,7 +288,8 @@ class MeshRunner:
 
         def init_body(keys):
             keys = jax.tree.map(lambda a: a[0], keys)  # drop party block axis
-            f = collect.tree_init(keys, root_bucket)
+            # the mesh bodies pin the XLA engine, so pin its layout too
+            f = collect.tree_init(keys, root_bucket, planar=False)
             return jax.tree.map(lambda a: a[None], f)
 
         self._init_fn = jax.jit(

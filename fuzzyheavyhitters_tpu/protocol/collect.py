@@ -71,16 +71,19 @@ MAX_DIMS = 8  # packed-u32 layout holds d*4 bits
 EVAL_PALLAS = False
 
 # Engine for the level expansion itself (the crawl's dominant op): True
-# routes it through the fused Pallas kernel (ops/expand_pallas.py),
-# bit-exact vs the XLA form.  OFF by default, with the round-4 numbers
-# recorded honestly: at the bench shape (B=1M states) the kernel body
-# itself wins (~5 ms vs ~16 ms for the whole XLA level), but the
-# word-planar layout glue it needs — [B,4] <-> [4,rows,8,128] transposes
-# on seeds in and both child seeds out — costs more than the win
-# (~37 ms end to end), and Mosaic hangs compiling the glue-free variant
-# that slices the minor seed axis in-kernel.  The known path to flipping
-# this default is keeping frontier seeds WORD-PLANAR across the whole
-# crawl so the glue disappears; until then XLA is the faster engine.
+# routes it through the fused Pallas kernel (ops/expand_pallas.py) with
+# WORD-PLANAR frontier seeds (every layout step a reshape, never a
+# transpose); False (default) keeps the XLA ChaCha with interleaved
+# [..., 4] seeds.  Round-4 measurements on v5e, recorded honestly: the
+# kernel body alone beats the XLA expansion (~5 ms vs ~14 ms at B = 1M
+# states), but XLA cannot fuse the pack/cache glue ACROSS the pallas_call
+# boundary — the unfused elementwise ops and kernel-operand copies eat the
+# win (~19 ms end to end vs ~14 ms all-XLA, both within tunnel noise) —
+# so the planar engine ships as a bit-exact, parity-tested opt-in
+# (tests/test_expand_pallas.py) rather than the default.  The remaining
+# lever is folding the share-bit pack into the kernel itself.  The engine
+# — and with it the frontier seed LAYOUT — is read at tree_init / expand /
+# advance time and must not flip mid-crawl.
 EXPAND_PALLAS: bool = False
 
 
@@ -96,6 +99,12 @@ class Frontier(NamedTuple):
 
     ``F`` is the current *bucket* — the smallest power of two holding the
     live nodes (see :func:`bucket_for`), not a global maximum.
+
+    Seed LAYOUT depends on the expansion engine: the XLA engine keeps
+    ``seed`` interleaved ``[F, N, d, 2, 4]``; the planar Pallas engine
+    keeps it word-planar ``[4, F, N, d, 2]`` so the kernel's operands are
+    pure reshapes (ops/expand_pallas.py).  ``bit``/``y_bit`` are always
+    ``[F, N, d, 2]``.
     """
 
     states: EvalState
@@ -125,14 +134,28 @@ def bucket_for(n_alive: int, f_max: int, min_bucket: int = 1) -> int:
     return min(f_max, max(b, min_bucket))
 
 
-def tree_init(keys: IbDcfKeyBatch, f_bucket: int = 1) -> Frontier:
+def tree_init(
+    keys: IbDcfKeyBatch, f_bucket: int = 1, planar: bool | None = None
+) -> Frontier:
     """Root frontier: one alive node whose states are eval_init of every
     (client, dim, side) key (ref: collect.rs:67-92).  The root bucket is 1
-    slot; it grows with the survivor count (``bucket_for``)."""
+    slot; it grows with the survivor count (``bucket_for``).
+
+    ``planar`` selects the seed layout (see :class:`Frontier`); None
+    follows the process engine — callers that pin an engine (the mesh
+    bodies pin XLA) must pin the matching layout here."""
+    if planar is None:
+        planar = _expand_engine()
     root = ibdcf.eval_init(keys)  # [N, d, 2]
     pad = lambda a: jnp.broadcast_to(a[None], (f_bucket,) + a.shape)
     alive = jnp.zeros((f_bucket,), bool).at[0].set(True)
-    return Frontier(states=EvalState(*[pad(x) for x in root]), alive=alive)
+    if planar:
+        seed = jnp.moveaxis(root.seed, -1, 0)  # [4, N, d, 2]
+        seed = jnp.broadcast_to(seed[:, None], (4, f_bucket) + seed.shape[1:])
+        states = EvalState(seed=seed, bit=pad(root.bit), y_bit=pad(root.y_bit))
+    else:
+        states = EvalState(*[pad(x) for x in root])
+    return Frontier(states=states, alive=alive)
 
 
 def _bit_positions(d: int):
@@ -197,25 +220,33 @@ def _expand_share_bits_jit(keys, frontier, level, derived_bits,
     st = frontier.states  # leaves [F, N, d, 2(,4)]
     shp = st.bit.shape  # [F, N, d, 2]
     if use_pallas:
-        # fused kernel over the flat state axis; the cw broadcast over
-        # nodes and the reshapes stay in XLA (bandwidth-trivial)
+        # fused kernel over the flat state axis, operands word-planar
+        # (frontier seeds already are — see Frontier): every layout step
+        # is a reshape or broadcast, never a transpose, except one TINY
+        # per-level cw transpose ([N, d, 2, 4])
         from ..ops import expand_pallas
 
         F = shp[0]
         B = int(np.prod(shp))
-        def bflat(a):  # [N, d, 2, ...] -> broadcast over F -> [B, ...]
-            b = jnp.broadcast_to(a[None], (F,) + a.shape)
-            return b.reshape((B,) + b.shape[4:])
-        sl, sr, bl, br, yl, yr = expand_pallas.expand_flat(
-            st.seed.reshape(B, 4), st.bit.reshape(B), st.y_bit.reshape(B),
-            bflat(cw_seed),
+
+        def bflat(a):  # [N, d, 2] -> broadcast over F -> [B]
+            return jnp.broadcast_to(a[None], (F,) + a.shape).reshape(B)
+
+        cwp = jnp.moveaxis(jnp.asarray(cw_seed, jnp.uint32), -1, 0)
+        cws_p = jnp.broadcast_to(
+            cwp[:, None], (4, F) + cwp.shape[1:]
+        ).reshape(4, B)
+        sl, sr, bl, br, yl, yr = expand_pallas.expand_flat_planar(
+            st.seed.reshape(4, B), st.bit.reshape(B), st.y_bit.reshape(B),
+            cws_p,
             bflat(cw_bits[..., 0]), bflat(cw_bits[..., 1]),
             bflat(cw_y[..., 0]), bflat(cw_y[..., 1]),
             derived_bits,
         )
         nb = jnp.stack([bl, br], axis=-1).reshape(shp + (2,))
         ny = jnp.stack([yl, yr], axis=-1).reshape(shp + (2,))
-        seeds = jnp.stack([sl, sr], axis=-2).reshape(shp + (2, 4))
+        # children seeds stay planar: [4, B, 2dirs] -> [4, F, N, d, 2, 2]
+        seeds = jnp.stack([sl, sr], axis=-1).reshape((4,) + shp + (2,))
     else:
         # one fully-batched XLA expansion over (node, client, dim, side)
         s_l, s_r, tau_b, tau_y = prg.expand(st.seed, derived_bits)
@@ -259,18 +290,31 @@ def advance_from_children(
     Both keys of a dim take the same direction bit: the interval pair
     walks together (ref: collect.rs:100, ibDCF.rs:120-131).
     """
-    return _advance_children_jit(children, parent_idx, pattern_bits, n_alive)
-
-
-@jax.jit
-def _advance_children_jit(children, parent_idx, pattern_bits, n_alive):
-    ch = jax.tree.map(lambda a: a[parent_idx], children)  # [F', N, d, 2, 2, ...]
-    dirb = pattern_bits[:, None, :, None]  # [F', 1, d, 1] -> broadcast [F', N, d, 2]
-    states = EvalState(
-        seed=jnp.where(dirb[..., None], ch.seed[..., 1, :], ch.seed[..., 0, :]),
-        bit=jnp.where(dirb, ch.bit[..., 1], ch.bit[..., 0]),
-        y_bit=jnp.where(dirb, ch.y_bit[..., 1], ch.y_bit[..., 0]),
+    return _advance_children_jit(
+        children, parent_idx, pattern_bits, n_alive, _expand_engine()
     )
+
+
+@partial(jax.jit, static_argnames=("planar",))
+def _advance_children_jit(children, parent_idx, pattern_bits, n_alive,
+                          planar=False):
+    dirb = pattern_bits[:, None, :, None]  # [F', 1, d, 1] -> bcast [F', N, d, 2]
+    if planar:  # children.seed is [4, F, N, d, 2, 2dirs]
+        ch_seed = children.seed[:, parent_idx]
+        bit = children.bit[parent_idx]
+        y = children.y_bit[parent_idx]
+        states = EvalState(
+            seed=jnp.where(dirb[None], ch_seed[..., 1], ch_seed[..., 0]),
+            bit=jnp.where(dirb, bit[..., 1], bit[..., 0]),
+            y_bit=jnp.where(dirb, y[..., 1], y[..., 0]),
+        )
+    else:
+        ch = jax.tree.map(lambda a: a[parent_idx], children)  # [F', N, d, 2, 2, ..]
+        states = EvalState(
+            seed=jnp.where(dirb[..., None], ch.seed[..., 1, :], ch.seed[..., 0, :]),
+            bit=jnp.where(dirb, ch.bit[..., 1], ch.bit[..., 0]),
+            y_bit=jnp.where(dirb, ch.y_bit[..., 1], ch.y_bit[..., 0]),
+        )
     alive = jnp.arange(parent_idx.shape[0]) < n_alive
     return Frontier(states=states, alive=alive)
 
@@ -316,11 +360,29 @@ def advance(
     Gathers the parents' states and advances one level with the pattern's
     per-dim direction (both keys of a dim take the same bit — the interval
     pair walks together, ref: collect.rs:100, ibDCF.rs:120-131).
+
+    Layout note: the eval recurrence wants interleaved seeds; under the
+    planar engine this rare path converts at the edges (tiny next to the
+    PRG work it is about to redo).
     """
-    return _advance_jit(
+    planar = _expand_engine()
+    if planar:
+        frontier = frontier._replace(
+            states=frontier.states._replace(
+                seed=jnp.moveaxis(frontier.states.seed, 0, -1)
+            )
+        )
+    out = _advance_jit(
         keys, frontier, level, parent_idx, pattern_bits, n_alive,
         prg.DERIVED_BITS, EVAL_PALLAS,
     )
+    if planar:
+        out = out._replace(
+            states=out.states._replace(
+                seed=jnp.moveaxis(out.states.seed, -1, 0)
+            )
+        )
+    return out
 
 
 @partial(jax.jit, static_argnames=("derived_bits", "use_pallas"))

@@ -1,14 +1,17 @@
 """Fused Pallas frontier expansion vs the XLA form — bit-exact on the
 real chip (same TPU-only gating rationale as test_keygen_pallas.py).
 
-The kernel is opt-in (collect.EXPAND_PALLAS, see the measured-layout-cost
-note there); parity is pinned here so the option stays sound.
+The planar engine (word-planar frontier seeds + ops/expand_pallas.py) is
+the DEFAULT on real chips, so this parity test pins the whole planar
+pipeline — expand share bits, child cache, gather-advance — against the
+XLA engine at every step of a small crawl.
 """
 
 import numpy as np
 import pytest
 
 import jax
+import jax.numpy as jnp
 
 
 def _has_tpu() -> bool:
@@ -22,7 +25,7 @@ pytestmark = pytest.mark.skipif(not _has_tpu(), reason="needs a TPU backend")
 
 
 @pytest.mark.parametrize("derived", [False, True])
-def test_expand_pallas_bit_exact(rng, derived):
+def test_planar_engine_bit_exact(rng, derived):
     from fuzzyheavyhitters_tpu.ops import ibdcf
     from fuzzyheavyhitters_tpu.protocol import collect
 
@@ -30,10 +33,34 @@ def test_expand_pallas_bit_exact(rng, derived):
     pts = rng.integers(0, 1 << L, size=(n, d))
     pts_bits = ((pts[..., None] >> np.arange(L - 1, -1, -1)) & 1) > 0
     k0, _ = ibdcf.gen_l_inf_ball(pts_bits, 3, rng, engine="np")
-    f = collect.tree_init(k0, 4)
+    f_x = collect.tree_init(k0, 4, planar=False)
+    f_p = collect.tree_init(k0, 4, planar=True)
+    np.testing.assert_array_equal(
+        np.asarray(jnp.moveaxis(f_p.states.seed, 0, -1)),
+        np.asarray(f_x.states.seed),
+    )
+    parent = jnp.asarray(np.array([0, 1, 3, 0], np.int32))
+    pat = jnp.asarray(rng.integers(0, 2, size=(4, d)).astype(bool))
     for lvl in (0, 7):
-        p_x, ch_x = collect._expand_share_bits_jit(k0, f, lvl, derived, True, False)
-        p_p, ch_p = collect._expand_share_bits_jit(k0, f, lvl, derived, True, True)
+        p_x, ch_x = collect._expand_share_bits_jit(k0, f_x, lvl, derived, True, False)
+        p_p, ch_p = collect._expand_share_bits_jit(k0, f_p, lvl, derived, True, True)
         np.testing.assert_array_equal(np.asarray(p_x), np.asarray(p_p))
-        for a, b in zip(ch_x, ch_p):
-            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(ch_x.bit), np.asarray(ch_p.bit))
+        np.testing.assert_array_equal(np.asarray(ch_x.y_bit), np.asarray(ch_p.y_bit))
+        np.testing.assert_array_equal(
+            np.asarray(ch_x.seed),
+            np.asarray(jnp.moveaxis(ch_p.seed, 0, -1)),
+        )
+        a_x = collect._advance_children_jit(ch_x, parent, pat, 3, planar=False)
+        a_p = collect._advance_children_jit(ch_p, parent, pat, 3, planar=True)
+        np.testing.assert_array_equal(
+            np.asarray(a_x.states.seed),
+            np.asarray(jnp.moveaxis(a_p.states.seed, 0, -1)),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a_x.states.bit), np.asarray(a_p.states.bit)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a_x.states.y_bit), np.asarray(a_p.states.y_bit)
+        )
+        np.testing.assert_array_equal(np.asarray(a_x.alive), np.asarray(a_p.alive))

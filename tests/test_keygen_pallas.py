@@ -64,7 +64,7 @@ def test_pallas_advance_bit_exact(rng, derived):
     n, d, L, F = 300, 2, 8, 16
     pts = rng.integers(0, 2, size=(n, d, L)).astype(bool)
     k0, _ = ibdcf.gen_l_inf_ball(pts, 1, rng, engine="np")
-    f = collect.tree_init(k0, F)
+    f = collect.tree_init(k0, F, planar=False)  # _advance_jit is XLA-layout
     parent = jnp.zeros(F, jnp.int32)
     pat = jnp.asarray(rng.integers(0, 2, size=(F, d)).astype(bool))
     a = collect._advance_jit(k0, f, 0, parent, pat, 4, derived, False)
