@@ -418,13 +418,14 @@ class CollectorServer:
                 self._ot_snd, u2, mask, b2a_seed, count_field, garbler
             )
             await _send(self._peer_writer, np.asarray(jnp.stack([c0, c1])))
-        else:  # evaluator + OT receiver
-            u, t_rows = secure.ev_step1(self._ot_rcv, np.asarray(flat))
+        else:  # evaluator + OT receiver (inputs stay on device: each
+            # np.asarray here would cost a full tunnel round trip)
+            u, t_rows = secure.ev_step1(self._ot_rcv, flat)
             await _send(self._peer_writer, np.asarray(u))
             bmsg = await _recv(self._peer_reader)
             batch = secure.unpack_gc_batch(jnp.asarray(bmsg), B, S)
             e = secure.ev_step2(batch, t_rows, B, S)
-            u2, t2_rows, idx0 = secure.ev_step3(self._ot_rcv, np.asarray(e))
+            u2, t2_rows, idx0 = secure.ev_step3(self._ot_rcv, e)
             await _send(self._peer_writer, np.asarray(u2))
             cts = jnp.asarray(await _recv(self._peer_reader))
             vals = secure.ev_step4(

@@ -114,9 +114,11 @@ def derive_seed(base: np.ndarray, purpose: int, level: int, ctr: int = 0) -> np.
 
 def ev_step1(rcv: otext.OtExtReceiver, y_flat):
     """Evaluator: request input labels.  y_flat bool[B, S] -> (u message,
-    T rows uint32[B*S, 4] — the Δ-OT labels-to-be)."""
+    T rows uint32[B*S, 4] — the Δ-OT labels-to-be).  ``y_flat`` may stay
+    a DEVICE array — fetching it first costs a tunnel round trip and the
+    extension consumes it on device anyway."""
     B, S = y_flat.shape
-    u, t = rcv.extend(np.asarray(y_flat).reshape(B * S))
+    u, t = rcv.extend(jnp.reshape(jnp.asarray(y_flat), (B * S,)))
     return u, t
 
 
@@ -139,9 +141,10 @@ def ev_step2(batch: gc.GarbledEqBatch, t_rows, B: int, S: int) -> jax.Array:
 
 def ev_step3(rcv: otext.OtExtReceiver, e_bits):
     """Evaluator: open the b2a OT with its GC output shares as choices.
-    Returns (u message, T2 rows, idx0 — the pad tweak base)."""
+    Returns (u message, T2 rows, idx0 — the pad tweak base).  ``e_bits``
+    may stay a device array (see ev_step1)."""
     idx0 = rcv.consumed
-    u2, t2 = rcv.extend(np.asarray(e_bits))
+    u2, t2 = rcv.extend(jnp.asarray(e_bits))
     return u2, t2, idx0
 
 
