@@ -113,3 +113,22 @@ def test_pattern_masks_layout():
     assert m[0] == sum(1 << (j * 4 + s * 2) for j in range(2) for s in range(2))
     # pattern 3: dirs (1,1)
     assert m[3] == sum(1 << (j * 4 + s * 2 + 1) for j in range(2) for s in range(2))
+
+
+def test_bucket_for_and_compact_survivors():
+    """Bucketed-frontier helpers: power-of-2 sizing with the f_max cap and
+    the min_bucket pin, and compact_survivors padding to the bucket."""
+    assert [collect.bucket_for(n, 64) for n in (0, 1, 2, 3, 4, 5, 33, 64)] == [
+        1, 1, 2, 4, 4, 8, 64, 64,
+    ]
+    assert collect.bucket_for(3, 64, min_bucket=16) == 16
+    assert collect.bucket_for(60, 64, min_bucket=16) == 64
+    with pytest.raises(ValueError, match="f_max"):
+        collect.bucket_for(65, 64)
+    keep = np.zeros((4, 2), bool)
+    keep[0, 1] = keep[2, 0] = keep[3, 1] = True
+    parent, pattern, n_alive = collect.compact_survivors(keep, 64)
+    assert n_alive == 3 and parent.shape == (4,)  # padded to bucket 4
+    assert parent[:3].tolist() == [0, 2, 3]
+    assert pattern[:3].tolist() == [1, 0, 1]
+    assert parent[3] == 0 and pattern[3] == 0  # zero padding
