@@ -110,6 +110,7 @@ def bench_keygen(jax, jnp, ibdcf, rng, sweep=(64, 128, 256, 512, 1024), n=8192):
 
         keys_per_sec, k0 = _throughput(
             jnp, gen_pair_pallas, seeds_d, alpha_d, side_d, n,
+            iters=64,  # deep queue: amortize the end-of-batch fetch RTT
             trials=6 if L == 512 else 3,  # headline: more min-of-trials
             # insurance against the tunnel's cross-run queueing variance
         )
