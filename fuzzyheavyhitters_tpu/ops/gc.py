@@ -43,7 +43,6 @@ OT, exactly the reference's wire-exchange split (equalitytest.rs:68-82,
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple
 
 import jax

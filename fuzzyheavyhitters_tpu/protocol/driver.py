@@ -20,7 +20,6 @@ Level-loop semantics mirror the reference leader (ref: leader.rs:185-297):
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List
 
 import numpy as np
 

@@ -68,7 +68,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import dpf, prg
-from ..ops.dpf import DpfEvalState, DpfKeyBatch
+from ..ops.dpf import DpfKeyBatch
 from . import mpc
 
 LANES = 2  # payload lanes: (x, k·x)
