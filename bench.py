@@ -62,6 +62,12 @@ def _key_wire_bytes(k0) -> int:
     return per
 
 
+def _time_of(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
 def _steady_state_seconds(thunk, force, warm_force, iters=20, trials=3):
     """Min-of-trials per-launch seconds for a device thunk.
 
@@ -310,6 +316,15 @@ def bench_secure(n=1024, L=12, port=39831):
     with contextlib.redirect_stdout(io.StringIO()):  # phase-timer prints
         dt, hitters, gc_tests, phases = asyncio.run(run())
     fss, gcot, fld = (round(p, 3) for p in phases)
+    # the e2e floor: every device->host fetch in the serial 2PC message
+    # flow costs one of these (≈6 per level after round-4's packing)
+    import jax.numpy as jnp
+
+    a = jnp.zeros(4, jnp.uint32) + 1
+    np.asarray(a)  # warm
+    rtt = min(
+        _time_of(lambda: np.asarray(a + i)) for i in range(3)
+    )
     return {
         "secure_clients_per_sec": round(n / dt, 1),
         "secure_crawl_seconds": round(dt, 3),
@@ -326,6 +341,7 @@ def bench_secure(n=1024, L=12, port=39831):
         "phase_fss_seconds": fss,
         "phase_gc_ot_seconds": gcot,
         "phase_field_seconds": fld,
+        "device_fetch_rtt_ms": round(rtt * 1000, 1),
     }
 
 
