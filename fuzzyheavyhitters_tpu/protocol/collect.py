@@ -80,8 +80,13 @@ EVAL_PALLAS = False
 # boundary — the unfused elementwise ops and kernel-operand copies eat the
 # win (~19 ms end to end vs ~14 ms all-XLA, both within tunnel noise) —
 # so the planar engine ships as a bit-exact, parity-tested opt-in
-# (tests/test_expand_pallas.py) rather than the default.  The remaining
-# lever is folding the share-bit pack into the kernel itself.  The engine
+# (tests/test_expand_pallas.py) rather than the default.  The
+# fold-the-pack-into-the-kernel variant was also prototyped and measured
+# (plane-major layout, cw broadcast over nodes via a modular BlockSpec
+# index map, packed u32 emitted in-kernel; bit-exact): 4.1 ms vs 5.7 ms
+# for the XLA expand back-to-back on a quiet chip — 1.4x on one stage
+# does not buy a third state layout.  NB the shared chip's throughput
+# swings ~4x by hour; only back-to-back A/Bs are meaningful.  The engine
 # — and with it the frontier seed LAYOUT — is read at tree_init / expand /
 # advance time and must not flip mid-crawl.
 EXPAND_PALLAS: bool = False
