@@ -296,30 +296,38 @@ class MeshRunner:
             jax.shard_map(init_body, mesh=mesh, in_specs=(kspec,), out_specs=fspec)
         )
 
-        def counts_body(keys, frontier, alive_keys, level):
-            keys = jax.tree.map(lambda a: a[0], keys)
-            frontier = jax.tree.map(lambda a: a[0], frontier)
-            alive = alive_keys[0]
-            packed, children = collect._expand_share_bits_jit(
-                keys, frontier, level, derived
-            )
-            # one u32 per (node, client): the whole inter-party data plane
-            peer = jax.lax.ppermute(packed, SERVERS, perm=[(0, 1), (1, 0)])
-            cnt = collect.counts_by_pattern(packed, peer, masks, alive, frontier.alive)
-            cnt = jax.lax.psum(cnt, DATA)
-            # both parties compute identical counts (the compare is
-            # symmetric); psum/2 over servers makes replication explicit
-            cnt = jax.lax.psum(cnt, SERVERS) // 2
-            return cnt, jax.tree.map(lambda a: a[None], children)
+        def make_counts_fn(want_children: bool):
+            def counts_body(keys, frontier, alive_keys, level):
+                keys = jax.tree.map(lambda a: a[0], keys)
+                frontier = jax.tree.map(lambda a: a[0], frontier)
+                alive = alive_keys[0]
+                packed, children = collect._expand_share_bits_jit(
+                    keys, frontier, level, derived, want_children
+                )
+                # one u32 per (node, client): the whole inter-party data plane
+                peer = jax.lax.ppermute(packed, SERVERS, perm=[(0, 1), (1, 0)])
+                cnt = collect.counts_by_pattern(
+                    packed, peer, masks, alive, frontier.alive
+                )
+                cnt = jax.lax.psum(cnt, DATA)
+                # both parties compute identical counts (the compare is
+                # symmetric); psum/2 over servers makes replication explicit
+                cnt = jax.lax.psum(cnt, SERVERS) // 2
+                if not want_children:  # last level: nothing advances past it
+                    return cnt
+                return cnt, jax.tree.map(lambda a: a[None], children)
 
-        self._counts_fn = jax.jit(
-            jax.shard_map(
-                counts_body,
-                mesh=mesh,
-                in_specs=(kspec, fspec, P(SERVERS, DATA), P()),
-                out_specs=(P(), cspec),
+            return jax.jit(
+                jax.shard_map(
+                    counts_body,
+                    mesh=mesh,
+                    in_specs=(kspec, fspec, P(SERVERS, DATA), P()),
+                    out_specs=(P(), cspec) if want_children else P(),
+                )
             )
-        )
+
+        self._counts_fn = make_counts_fn(True)
+        self._counts_last_fn = make_counts_fn(False)
 
         def advc_body(children, parent, pat_bits, n_alive):
             ch = jax.tree.map(lambda a: a[0], children)
@@ -335,7 +343,7 @@ class MeshRunner:
             )
         )
 
-    def _secure_counts_fn(self, field, garbler: int = 0):
+    def _secure_counts_fn(self, field, garbler: int = 0, want_children: bool = True):
         """Build (and cache) the one-program secure level crawl for a
         (count field, garbler party) pair: the whole GC+OT 2PC — label
         extension, garbling, evaluation, b2a, alive-gated share sums — as
@@ -351,12 +359,14 @@ class MeshRunner:
         secrets between shards (u_A ^ u_B = r_A ^ r_B, and identical X0
         labels reveal x_A ^ x_B), so every seed is tweaked by the shard
         index inside the body — consistently on both parties."""
-        key = ("secure", field.__name__, garbler)
+        key = ("secure", field.__name__, garbler, want_children)
         if key not in self._kernel_cache:
-            self._kernel_cache[key] = self._make_secure_body(field, garbler)
+            self._kernel_cache[key] = self._make_secure_body(
+                field, garbler, want_children
+            )
         return self._kernel_cache[key]
 
-    def _make_secure_body(self, field, g: int):
+    def _make_secure_body(self, field, g: int, want_children: bool = True):
         mesh, derived, d = self.mesh, self._derived, self.n_dims
         kspec, fspec = self._key_spec, self._frontier_spec
         limb = field.limb_shape
@@ -381,7 +391,7 @@ class MeshRunner:
             bseed = bseed.at[2].set(bseed[2] ^ (shard << 16))
 
             packed, children = collect._expand_share_bits_jit(
-                keys_l, frontier_l, level, derived
+                keys_l, frontier_l, level, derived, want_children
             )
             strs = secure.child_strings(packed, d)  # [F, C, Nl, S]
             F_, C, Nl, S = strs.shape
@@ -438,6 +448,8 @@ class MeshRunner:
             expand = jnp.zeros((2,) + shares.shape, shares.dtype)
             expand = expand.at[party_row].set(shares)
             allsh = jax.lax.psum(expand, SERVERS)
+            if not want_children:  # last level: nothing advances past it
+                return allsh
             return allsh, jax.tree.map(lambda a: a[None], children)
 
         fn = jax.jit(
@@ -449,7 +461,9 @@ class MeshRunner:
                     P(SERVERS, None, None), P(SERVERS, None, None),
                     P(SERVERS, None), P(SERVERS, None), P(), P(), P(),
                 ),
-                out_specs=(P(), self._child_spec),
+                out_specs=(
+                    (P(), self._child_spec) if want_children else P()
+                ),
             )
         )
         return fn
@@ -460,43 +474,61 @@ class MeshRunner:
         self.frontier = self._init_fn(self.keys)
         self._children = None
 
-    def level_counts(self, level: int) -> np.ndarray:
+    def level_counts(self, level: int, last: bool = False) -> np.ndarray:
         """Crawl counts for every child of the current frontier: the
         expand → exchange(ppermute) → compare → psum pipeline.  The
-        both-direction child states are cached for :meth:`advance`."""
-        cnt, self._children = self._counts_fn(
-            self.keys, self.frontier, self.alive_keys, jnp.int32(level)
-        )
+        both-direction child states are cached for :meth:`advance`;
+        ``last=True`` (the final level, which nothing advances past)
+        skips materializing the cache."""
+        if last:
+            cnt = self._counts_last_fn(
+                self.keys, self.frontier, self.alive_keys, jnp.int32(level)
+            )
+            self._children = None
+        else:
+            cnt, self._children = self._counts_fn(
+                self.keys, self.frontier, self.alive_keys, jnp.int32(level)
+            )
         return np.asarray(cnt)
 
-    def level_count_shares(self, level: int, field=FE62) -> np.ndarray:
+    def level_count_shares(self, level: int, field=FE62, last: bool = False) -> np.ndarray:
         """Secure crawl: both parties' additive count shares [2, F, 2^d
         (, limbs)] — reconstruct as field.sub(shares[0], shares[1]).  The
         level field mirrors the socket path: FE62 inner levels, F255 last
         (ref: rpc.rs:60-62); the garbler alternates per level (gc_sender
-        flip), each direction consuming its own OT-extension session."""
+        flip), each direction consuming its own OT-extension session;
+        ``last=True`` skips the child-state cache."""
         assert self.secure, "runner built without secure_exchange"
         g = level % 2
         sess = self._sec[g]
-        fn = self._secure_counts_fn(field, g)
+        fn = self._secure_counts_fn(field, g, not last)
         self._crawl_ctr += 1
         gseed = secure.derive_seed(self._sec_seed, 1, level, self._crawl_ctr)
         bseed = secure.derive_seed(self._sec_seed, 2, level, self._crawl_ctr)
         z = np.zeros(4, np.uint32)
-        put = lambda a: self._host_put(np.stack([a, z]), P(SERVERS, None))
+        # the derived seeds go in the GARBLER's mesh row (the body reads its
+        # own row) — with alternation, pinning row 0 would hand odd levels'
+        # garbler an all-zero seed and destroy per-level freshness
+        put = lambda a: self._host_put(
+            np.stack([a, z] if g == 0 else [z, a]), P(SERVERS, None)
+        )
         # static per-call shapes -> deterministic stream consumption; the
         # GC/OT batch is sized to the CURRENT frontier bucket, not f_max
         n_local = self.keys.cw_seed.shape[1] // self.mesh.shape[DATA]
         f_cur = self.frontier.alive.shape[1]
         B = f_cur * (1 << self.n_dims) * n_local
         m = B * 2 * self.n_dims
-        shares, self._children = fn(
+        out = fn(
             self.keys, self.frontier, self.alive_keys,
             sess["s_bits"], sess["seeds_main"], sess["seeds_aux"],
             put(gseed), put(bseed),
             jnp.uint32(sess["blocks"]), jnp.uint32(sess["sent"]),
             jnp.int32(level),
         )
+        if last:
+            shares, self._children = out, None
+        else:
+            shares, self._children = out
         w1 = -(-m // 32)
         w2 = -(-B // 32)
         sess["blocks"] += (-(-w1 // 16)) + (-(-w2 // 16))
@@ -530,10 +562,11 @@ class MeshLeader:
         reconstruction v0 - v1 of the parties' share outputs in secure mode
         (FE62 inner levels, F255 last — ref: rpc.rs:60-62)."""
         r = self.r
+        last = level == r.data_len - 1
         if not r.secure:
-            return r.level_counts(level)
-        if level == r.data_len - 1:
-            sh = r.level_count_shares(level, F255)
+            return r.level_counts(level, last=last)
+        if last:
+            sh = r.level_count_shares(level, F255, last=True)
             v = np.asarray(F255.sub(sh[0], sh[1]))
             counts = v[..., 0].astype(np.uint32)
             if np.any(v[..., 1:]):
@@ -569,7 +602,8 @@ class MeshLeader:
                     paths=np.zeros((0, d, level + 1), bool),
                     counts=np.zeros(0, np.uint32),
                 )
-            r.advance(level, parent, pat_bits, n_alive)
+            if level < r.data_len - 1:  # nothing advances past the leaves
+                r.advance(level, parent, pat_bits, n_alive)
             new_paths = np.zeros((n_alive, d, self.paths.shape[-1] + 1), bool)
             for i in range(n_alive):
                 new_paths[i, :, :-1] = self.paths[parent[i]]

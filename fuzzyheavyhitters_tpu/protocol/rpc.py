@@ -250,6 +250,16 @@ class CollectorServer:
                 raise RuntimeError(
                     "data_len=1: the leaf check is the level-0 full check"
                 )
+            if level == 1:
+                # the server is the enforcement boundary, not the leader:
+                # depth 1's triples (index 0) were consumed by the level-0
+                # full check, and opening them again under level 1's
+                # different challenge reveals <r - r', x> of honest
+                # clients' payloads
+                raise RuntimeError(
+                    "depth 1 is covered by the level-0 full check; "
+                    "re-verifying it would re-open its Beaver triples"
+                )
             if self._sketch_pairs is None or self._sketch_pairs[1] != level:
                 raise RuntimeError(f"no stored sketch shares for depth {level}")
             pairs_fn, _ = self._sketch_pairs  # [F, N, d, LANES(, limbs)]
@@ -290,6 +300,13 @@ class CollectorServer:
             peer_o = await self._swap(o)
             ok_nd = np.asarray(mpc.verify(fld, o, peer_o))  # [n_sl, d]
             ok[sl] = ok_nd.all(axis=1)
+        if level != 0:
+            # one-shot: each stored depth's triples open exactly once (a
+            # repeat would be a same-challenge replay at best — reject it
+            # outright rather than reason about it).  The level-0 path has
+            # no stored pairs and its re-run replays the identical
+            # level-tagged challenge, revealing nothing new.
+            self._sketch_pairs = None
         self.alive_keys &= ok
         return self.alive_keys.copy()
 
