@@ -80,10 +80,13 @@ async def _recv(reader: asyncio.StreamReader):
 
 def _mask_words(level: int, n: int, blocks_for: int) -> np.ndarray:
     """Shared pseudorandom mask words for one level (both servers derive the
-    same stream, so shares cancel on reconstruction)."""
+    same stream, so shares cancel on reconstruction).  Host NumPy on
+    purpose: the mask is tiny (F·2^d elements) and the device version
+    would cost a device->host round trip per level per server — a full
+    tunnel RTT on remote-chip deployments."""
     seed = prg.seeds_from_bytes(SHARED_MASK_SEED)[0].copy()
     seed[3] ^= np.uint32(level)
-    return np.asarray(prg.stream_words(seed, n * blocks_for)).reshape(n, blocks_for)
+    return prg.np_stream_words(seed, n * blocks_for).reshape(n, blocks_for)
 
 
 def mask_fe62(level: int, n: int) -> np.ndarray:
