@@ -293,10 +293,12 @@ class CollectorServer:
             mk = jnp.expand_dims(jnp.asarray(mk), 1)  # broadcast over dims
             mk2 = jnp.expand_dims(jnp.asarray(mk2), 1)
             state = sketchmod.mul_state(fld, out, mk, mk2, trip)
-            cs = tuple(np.asarray(x) for x in mpc.cor_share(fld, state))
+            # one stacked array = one device fetch + one wire message
+            cs = np.asarray(jnp.stack(mpc.cor_share(fld, state)))
             peer_cs = await self._swap(cs)
             pair_cs = (cs, peer_cs) if self.server_id == 0 else (peer_cs, cs)
-            opened = mpc.cor(fld, *pair_cs)
+            opened = mpc.cor(fld, (pair_cs[0][0], pair_cs[0][1]),
+                             (pair_cs[1][0], pair_cs[1][1]))
             o = np.asarray(
                 mpc.out_share(fld, bool(self.server_id), state, opened)
             )
