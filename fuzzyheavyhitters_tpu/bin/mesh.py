@@ -1,0 +1,96 @@
+"""Mesh-deployment binary: the whole collection on one device mesh.
+
+The socket deployment (bin/server.py x2 + bin/leader.py) maps the
+reference's two-EC2-host shape; THIS binary is the pod shape — both
+parties and all client data parallelism live on a ``jax.sharding.Mesh``
+(2 x k: servers x data), the entire per-level 2PC rides ``ppermute`` /
+``psum`` collectives, and the host runs only the leader's threshold loop
+(parallel/mesh.py).  Single trust domain by construction — see
+``init_distributed``'s note; use the socket binaries when the two
+parties are separate administrative domains.
+
+::
+
+    python -m fuzzyheavyhitters_tpu.bin.mesh --config configs/config.json -n 1000
+
+Multi-host: set ``--processes N --process_id I --coordinator HOST:PORT``
+on each host; process i supplies only party i's keys when N == 2
+(MeshRunner.from_process_local).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+from ..ops import ibdcf
+from ..parallel import mesh as meshmod
+from ..utils import config as configmod
+from ..workloads import strings
+
+AUG_LEN = 8  # per-request augmentation bits (ref: leader.rs:331)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(
+        prog="Mesh", description="TPU-mesh private fuzzy heavy hitters."
+    )
+    p.add_argument("-c", "--config", required=True)
+    p.add_argument("-n", "--num_requests", type=int, required=True)
+    p.add_argument("--coordinator", default=None)
+    p.add_argument("--processes", type=int, default=None)
+    p.add_argument("--process_id", type=int, default=None)
+    p.add_argument("--devices", type=int, default=None,
+                   help="use only the first N devices (default: all)")
+    p.add_argument("--platform", default=None,
+                   help='pin the JAX platform (e.g. "cpu" for a virtual '
+                        "host-device mesh; must be set before backend init)")
+    args = p.parse_args()
+    cfg = configmod.load_config(args.config)
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    if args.processes:
+        meshmod.init_distributed(
+            args.coordinator, args.processes, args.process_id
+        )
+
+    rng = np.random.default_rng()
+    n = args.num_requests
+    print(f"{cfg.distribution} distribution sampling...")
+    if cfg.distribution != "zipf":
+        raise SystemExit("mesh binary ships the zipf workload; see bin/leader.py")
+    pts, _ = strings.zipf_workload(
+        rng, cfg.num_sites, cfg.data_len, cfg.n_dims, cfg.zipf_exponent, n,
+        AUG_LEN,
+    )
+    t0 = time.perf_counter()
+    k0, k1 = ibdcf.gen_l_inf_ball(
+        pts, cfg.ball_size, rng,
+        engine="pallas" if jax.default_backend() not in ("cpu",) else "np",
+    )
+    print(f"keygen: {time.perf_counter() - t0:.2f}s for {n} clients")
+
+    mesh = meshmod.make_mesh(args.devices)
+    if args.processes == 2:
+        my = k0 if jax.process_index() == 0 else k1
+        runner = meshmod.MeshRunner.from_process_local(
+            mesh, my, cfg.f_max, secure_exchange=cfg.secure_exchange
+        )
+    else:
+        runner = meshmod.MeshRunner(
+            mesh, k0, k1, cfg.f_max, secure_exchange=cfg.secure_exchange
+        )
+    t0 = time.perf_counter()
+    res = meshmod.MeshLeader(runner).run(nreqs=n, threshold=cfg.threshold)
+    print(f"Crawl done in {time.perf_counter() - t0:.2f}s")
+    for row, c in zip(res.decode_ints(), res.counts):
+        print(f"Final {row.tolist()} -> {int(c)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -100,3 +100,38 @@ def test_binaries_end_to_end(tmp_path):
                 p.kill()
     want = _expected_csv(tmp_path)
     assert got == want
+
+
+def test_mesh_binary_smoke(tmp_path):
+    """The pod-deployment entry point (bin/mesh.py) runs a zipf collection
+    on the virtual 2x4 CPU mesh and prints heavy hitters."""
+    cfg = {
+        "data_len": 8,
+        "n_dims": 1,
+        "ball_size": 1,
+        "addkey_batch_size": 16,
+        "num_sites": 4,
+        "threshold": 0.1,
+        "zipf_exponent": 1.03,
+        "server0": "127.0.0.1:1",
+        "server1": "127.0.0.1:2",
+        "distribution": "zipf",
+        "f_max": 64,
+    }
+    cfg_path = tmp_path / "mesh.json"
+    cfg_path.write_text(json.dumps(cfg))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+        + " --xla_backend_optimization_level=1"
+    ).strip()
+    out = subprocess.run(
+        [sys.executable, "-m", "fuzzyheavyhitters_tpu.bin.mesh",
+         "--config", str(cfg_path), "-n", "32", "--platform", "cpu"],
+        cwd=tmp_path, env=env, capture_output=True, text=True, timeout=540,
+    )
+    assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-2000:]
+    assert "Crawl done" in out.stdout
+    assert "Final " in out.stdout  # zipf head sites surface as hitters
