@@ -134,4 +134,7 @@ def test_mesh_binary_smoke(tmp_path):
     )
     assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-2000:]
     assert "Crawl done" in out.stdout
-    assert "Final " in out.stdout  # zipf head sites surface as hitters
+    # NB no hitter-count assertion: the zipf workload appends 8 random
+    # augmentation bits per request (leader.rs:331 parity), so leaf-level
+    # hitters are luck at smoke scale; hitter correctness is pinned by the
+    # driver-oracle tests, this test pins that the BINARY runs end to end
