@@ -91,6 +91,14 @@ def test_mesh_secure_matches_trusted(client_batch, colocated_result, cpu_devices
     got = _as_dict(meshmod.MeshLeader(runner).run(nreqs=n, threshold=0.1))
     assert got == colocated_result
 
+    # regression pin (round-4 review finding): the ALTERNATING garbler's
+    # per-level gc/b2a seeds must land in its own mesh row — with a zero
+    # seed on the odd-level (garbler=1) side, the b2a share stream repeats
+    # identically across crawls (the OT pads cancel out of the shares)
+    sh_a = runner.level_count_shares(1)
+    sh_b = runner.level_count_shares(1)
+    assert not np.array_equal(sh_a, sh_b)
+
 
 def test_odd_device_count_rejected(cpu_devices):
     with pytest.raises(AssertionError, match="even"):
