@@ -128,6 +128,7 @@ class CollectorServer:
     _sketch: object | None = None  # SketchKeyBatch (malicious-secure mode)
     _sketch_states: object | None = None  # DpfEvalState [F, N, d], frontier-following
     _sketch_pids: np.ndarray | None = None  # int32[F, d] per-dim prefix ids
+    _sketch_depth: int = 0  # how far the sketch frontier has advanced
     _sketch_pairs: tuple | None = None  # (pair shares [F, N, d, lanes], depth)
     _sketch_pairs_field: object | None = None
     _sketch_seed: np.ndarray | None = None  # coin-flipped challenge seed
@@ -150,6 +151,7 @@ class CollectorServer:
         self._sketch = None
         self._sketch_states = None
         self._sketch_pids = None
+        self._sketch_depth = 0
         self._sketch_pairs = None
         self._sketch_pairs_field = None
         self._gc_tests = 0
@@ -202,6 +204,7 @@ class CollectorServer:
             self._sketch_pids = np.zeros(
                 (1, self._sketch.key.root_seed.shape[1]), np.int32
             )
+            self._sketch_depth = 0
             self._sketch_pairs = None
         return True
 
@@ -231,6 +234,14 @@ class CollectorServer:
         L = k.data_len
         n, d = k.root_seed.shape[0], k.root_seed.shape[1]
         if level == 0:
+            if self._sketch_depth != 0:
+                # the root check must run before the first prune: the
+                # frontier-following states have advanced past the root,
+                # so a late call would verify garbage and corrupt honest
+                # clients' liveness flags
+                raise RuntimeError(
+                    "level-0 full check called after the tree advanced"
+                )
             # full-width depth-1 check: both children of the root per dim
             last = L == 1
             fld = F255 if last else FE62
@@ -357,6 +368,7 @@ class CollectorServer:
         pair = jnp.where(gate, pair, 0)
         self._sketch_states = new_st
         self._sketch_pids = pids
+        self._sketch_depth = level + 1
         self._sketch_pairs = (pair, level + 1)
         self._sketch_pairs_field = fld
 
