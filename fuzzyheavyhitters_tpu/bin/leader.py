@@ -15,6 +15,7 @@ import asyncio
 import os
 import time
 
+import jax
 import numpy as np
 
 from ..ops import ibdcf
@@ -36,16 +37,25 @@ def _split(addr: str) -> tuple[str, int]:
     return host, int(port)
 
 
-def keygen_report(cfg, rng) -> None:
-    """Key-size / keys-per-second report (ref: leader.rs:90-104, 319-329)."""
-    t0 = time.perf_counter()
+def keygen_report(cfg, rng, engine: str) -> None:
+    """Key-size / keys-per-second report (ref: leader.rs:90-104, 319-329).
+
+    Runs on the fast engine for the backend (ibdcf.best_engine) with one
+    untimed warmup call so the report measures throughput, not the one-off
+    XLA compile."""
     n = min(cfg.num_sites, 1000)
     pts = np.stack(
         [strings.generate_random_bit_vectors(rng, cfg.data_len, cfg.n_dims) for _ in range(n)]
     )
-    k0, _ = ibdcf.gen_l_inf_ball(pts, 1, rng)
+    if engine != "np":  # numpy has no compile step to warm
+        k0, _ = ibdcf.gen_l_inf_ball(pts, 1, rng, engine=engine)
+        jax.block_until_ready(k0)
+    t0 = time.perf_counter()
+    k0, _ = ibdcf.gen_l_inf_ball(pts, 1, rng, engine=engine)
+    jax.block_until_ready(k0)
     dt = time.perf_counter() - t0
     per_client = sum(np.asarray(x)[0].nbytes for x in k0)
+    print(f"Keygen engine: {engine}")
     print(f"Key size: {per_client} bytes")
     print(f"Generated {n} keys in {dt:.3f} seconds ({dt / n:.6f} sec/key)")
 
@@ -80,8 +90,6 @@ def sample_points(cfg, nreqs: int, rng) -> np.ndarray:
 async def amain() -> None:
     import contextlib
 
-    import jax
-
     cfg, _, nreqs = configmod.get_args("Leader", get_n_reqs=True)
     rng = np.random.default_rng()
 
@@ -97,12 +105,15 @@ async def amain() -> None:
 
 
 async def _run(cfg, nreqs: int, rng) -> None:
+    # fast keygen engine for the backend (amain's default_device(cpu)
+    # context is visible to best_engine via utils.effective_platform)
+    engine = ibdcf.best_engine()
     print("Generating keys...")
-    keygen_report(cfg, rng)
+    keygen_report(cfg, rng, engine)
 
     print(f"{cfg.distribution} distribution sampling...")
     pts = sample_points(cfg, nreqs, rng)
-    k0, k1 = ibdcf.gen_l_inf_ball(pts, cfg.ball_size, rng)
+    k0, k1 = ibdcf.gen_l_inf_ball(pts, cfg.ball_size, rng, engine=engine)
 
     sk0 = sk1 = None
     if cfg.malicious:

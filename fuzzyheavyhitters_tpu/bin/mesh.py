@@ -70,8 +70,7 @@ def main() -> None:
     )
     t0 = time.perf_counter()
     k0, k1 = ibdcf.gen_l_inf_ball(
-        pts, cfg.ball_size, rng,
-        engine="pallas" if jax.default_backend() not in ("cpu",) else "np",
+        pts, cfg.ball_size, rng, engine=ibdcf.best_engine(),
     )
     print(f"keygen: {time.perf_counter() - t0:.2f}s for {n} clients")
 

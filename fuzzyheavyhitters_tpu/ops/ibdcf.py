@@ -302,6 +302,17 @@ def _rng_seeds(rng: np.random.Generator, shape) -> np.ndarray:
     return rng.integers(0, 1 << 32, size=tuple(shape) + (2, 4), dtype=np.uint32)
 
 
+def best_engine() -> str:
+    """Fastest keygen engine for the current default backend: the fused
+    Pallas kernel (ops/keygen_pallas.py) on an accelerator, the numpy
+    mirror on host CPU (where the XLA:CPU scan compile dominates).  The
+    deployment binaries (bin/leader.py, bin/mesh.py) select through this
+    so the headline keygen throughput never ships on the slow scan engine."""
+    from ..utils import effective_platform
+
+    return "np" if effective_platform() == "cpu" else "pallas"
+
+
 def _gen(engine: str):
     """Select the keygen implementation: "jax" (device scan), "np" (host),
     or "pallas" (the fused single-kernel TPU engine, ops/keygen_pallas.py —

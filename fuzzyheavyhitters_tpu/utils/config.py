@@ -19,8 +19,13 @@ Extra TPU-native knobs (all defaulted so reference configs load unchanged):
 - ``malicious``: if True, clients attach MAC'd sketch keys + Beaver triples
   (protocol/sketch.py — the resurrected sketch.rs/mpc.rs path named in
   BASELINE.json) and the servers verify every level, excluding cheating
-  clients via the liveness gate.  1-D distributions only (a one-hot sketch
-  does not extend to fuzzy L-inf balls).
+  clients via the liveness gate.  Covers the flagship fuzzy multi-dim
+  workloads: one payload DPF per dimension sharing the client's MAC key,
+  verified per dim with per-dim prefix dedup (the product frontier repeats
+  per-dim prefixes across slots).  Caveat: verification follows the
+  *frontier* the servers actually crawl — depth 1 is checked in full
+  before the first threshold, and the server refuses depth-1 re-verifies
+  afterward (Beaver-triple reuse under a fresh challenge would leak).
 - ``f_max``: padded-frontier capacity (static device shapes).
 """
 

@@ -51,6 +51,37 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    """Retry ``tpu_retry``-marked tests once when running against the remote
+    TPU tunnel, so a transport hiccup is distinguishable from a real layout
+    regression: a pass on immediate retry is reported as a warning (flake),
+    a second failure surfaces the ORIGINAL error unchanged.  Round 4 lost a
+    night to exactly this ambiguity (a parity test failed once at 21:49 and
+    passed deterministically ever after)."""
+    outcome = yield
+    if outcome.excinfo is None or item.get_closest_marker("tpu_retry") is None:
+        return
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except Exception:
+        on_tpu = False
+    if not on_tpu:
+        return
+    first_err = repr(outcome.excinfo[1])[:300]
+    try:
+        item.runtest()
+    except Exception:
+        return  # failed twice: deterministic — let the original error stand
+    outcome.force_result(None)
+    item.warn(
+        pytest.PytestWarning(
+            f"TPU tunnel flake: {item.nodeid} failed once "
+            f"({first_err}) and passed on immediate retry"
+        )
+    )
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)

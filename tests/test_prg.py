@@ -73,11 +73,15 @@ def test_mask_quirk(rng):
     seed = rng.integers(0, 2**32, size=(4,), dtype=np.uint32)
     seed2 = seed.copy()
     seed2[0] ^= np.uint32(0x0000000B)  # flip masked-away bits
-    l1, r1, b1, y1 = prg.expand(seed)
-    l2, r2, b2, y2 = prg.expand(seed2)
+    l1, r1, b1, y1 = prg.expand(seed, derived_bits=False)
+    l2, r2, b2, y2 = prg.expand(seed2, derived_bits=False)
     np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
     np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
     assert np.all(np.asarray(b1)) and np.all(np.asarray(y1))
+    # the seed mask applies in BOTH modes (prg.rs:97 masks before expanding)
+    ld, _, _, _ = prg.expand(seed, derived_bits=True)
+    ld2, _, _, _ = prg.expand(seed2, derived_bits=True)
+    np.testing.assert_array_equal(np.asarray(ld), np.asarray(ld2))
 
 
 def test_children_differ_and_nondegenerate(rng):
