@@ -47,11 +47,12 @@ import numpy as np
 
 from .keygen_pallas import LANES, SUB, _chacha16
 
-# row-groups per grid step.  Small on purpose: the blocks are output-heavy
-# (two child-seed planes per (dim, side)), and large blocks fill VMEM,
-# serializing DMA against compute (measured on the round-4 kernel: 11 ms at
-# R_BLK=32 vs 5 ms at R_BLK=4 for the same 1M-state batch).
-R_BLK = 4
+# row-groups per grid step.  Swept on-chip at the production shape
+# (B = 524288 rows x 2 planes): 4 -> 4.22 ms, 8 -> 3.91, 16 -> 3.97,
+# 32 -> 4.03 — this kernel's packed flag words keep blocks slim enough
+# that R_BLK=8 fits VMEM comfortably (the round-4 kernel's 14 fat refs
+# forced R_BLK=4).
+R_BLK = 8
 GROUP = SUB * LANES  # states per row
 
 
@@ -71,37 +72,41 @@ def _kernel(d2: int, derived_bits: bool, want_children: bool,
         oseeds_ref, oflags_ref = child_refs
     packed = None
     one = jnp.uint32(1)
+    # compute in collapsed 2-D [R_BLK*8, LANES] vregs: the 3-D block form
+    # costs ~7% on-chip (measured back-to-back, bit-exact either way)
+    sh2 = (R_BLK * SUB, LANES)
+    sh3 = (R_BLK, SUB, LANES)
     for p in range(d2):
-        f = flags_ref[p]
+        f = flags_ref[p].reshape(sh2)
         t = f & one
         y = (f >> 1) & one
         tm = jnp.uint32(0) - t
-        blk = [seed_ref[w * d2 + p] for w in range(4)]
+        blk = [seed_ref[w * d2 + p].reshape(sh2) for w in range(4)]
         blk[0] = blk[0] & jnp.uint32(0xFFFFFFF0)  # prg.rs:97 mask
         out = _chacha16(blk)
         if want_children:
             for w in range(4):  # both children, t-gated seed correction
-                cw = cws_ref[w * d2 + p]
-                oseeds_ref[w * d2 + p] = out[w] ^ (tm & cw)
-                oseeds_ref[(4 + w) * d2 + p] = out[4 + w] ^ (tm & cw)
+                cw = cws_ref[w * d2 + p].reshape(sh2)
+                oseeds_ref[w * d2 + p] = (out[w] ^ (tm & cw)).reshape(sh3)
+                oseeds_ref[(4 + w) * d2 + p] = (out[4 + w] ^ (tm & cw)).reshape(sh3)
         if derived_bits:
             w8 = out[8]
             b_l, b_r = (w8 & one) ^ one, ((w8 >> 1) & one) ^ one
             y_l, y_r = ((w8 >> 2) & one) ^ one, ((w8 >> 3) & one) ^ one
         else:  # the reference's masked-byte constants (prg.rs:103-104)
             b_l = b_r = y_l = y_r = jnp.full(t.shape, 1, jnp.uint32)
-        cf = cwf_ref[p]
+        cf = cwf_ref[p].reshape(sh2)
         bl = b_l ^ (t & (cf & one))
         br = b_r ^ (t & ((cf >> 1) & one))
         yl = y_l ^ (t & ((cf >> 2) & one)) ^ y
         yr = y_r ^ (t & ((cf >> 3) & one)) ^ y
         if want_children:
-            oflags_ref[p] = bl | (br << 1) | (yl << 2) | (yr << 3)
+            oflags_ref[p] = (bl | (br << 1) | (yl << 2) | (yr << 3)).reshape(sh3)
         # share bit = y ^ t per direction, packed at dim*4 + side*2 + dir
         # (collect._bit_positions; plane p = dim*2 + side)
         contrib = ((bl ^ yl) << (2 * p)) | ((br ^ yr) << (2 * p + 1))
         packed = contrib if packed is None else packed | contrib
-    packed_ref[...] = packed
+    packed_ref[...] = packed.reshape(sh3)
 
 
 @partial(jax.jit, static_argnames=("derived_bits", "want_children"))
