@@ -10,13 +10,21 @@ throughput vs the reference's single-threaded AES-NI baseline
   bytes (the ibDCFbench.rs:55-70 sweep + bincode size column);
 - ``aggregate_clients_per_sec``: the SERVER hot loop — a full
   data_len=512 trusted-mode crawl (expand -> exchange -> count ->
-  threshold -> prune/advance per level) over N clients on one chip;
-- ``secure_crawl``: the same loop with the REAL GC+OT data plane between
-  two in-process collector servers over localhost sockets (e2e — through
-  the remote-chip tunnel this is floored by ~0.12 s per device<->host
-  round trip, see ``secure_device`` for the deployment-shape number);
-- ``secure_device``: the whole per-level 2PC as one on-chip program (the
-  1-chip stand-in for the 2-chip mesh deployment);
+  threshold -> prune/advance per level) over N clients on one chip,
+  measured back-to-back on BOTH expand engines (pack-in-kernel Pallas
+  default vs XLA);
+- ``crawl_hbm_max``: a REAL measured crawl (no projections) at the
+  1-chip HBM maximum on BASELINE config 4's workload shape (zipf 10000
+  sites, t=0.001, L=512) via the streaming mode — host-resident keys,
+  per-level cw upload, chunked re-expand advance;
+- ``secure_crawl``: the level loop with the REAL GC+OT data plane between
+  two in-process collector servers over localhost sockets (e2e — the
+  fused output-label b2a makes a level ONE protocol round trip; through
+  the remote-chip tunnel it is still floored by ~3 device<->host round
+  trips/level, see ``secure_device`` for the deployment-shape number);
+- ``secure_device``: the whole per-level 2PC as one on-chip program at
+  flagship shape (>= 65k clients, L >= 64, plus an L=512-key level) —
+  the 1-chip stand-in for the 2-chip mesh deployment;
 - ``hbm``: the 1M-client HBM plan VALIDATED by allocation — the L=512
   key batch at the largest bench N actually lives on the chip, 3 levels
   run, and bytes/client are measured, not derived;
@@ -276,6 +284,151 @@ def bench_crawl(ibdcf, driver, rng, n=131072, L=512, f_max=64):
 
 
 
+def bench_crawl_hbm_max(rng, n=196608, L=512, sites=10000, threshold=0.001,
+                        zipf_exp=1.03, ball=2, aug=8):
+    """REAL measured crawl at the 1-chip HBM maximum — no projections.
+
+    BASELINE.json config 4's workload shape (zipf over 10000 sites,
+    data_len=512, threshold=0.001) at the largest client count one chip
+    can hold with BOTH servers colocated.  The round-4 HBM plan projected
+    ~663k clients from per-SERVER key bytes; this chip carries both
+    parties, and the binding constraint is frontier state
+    (F x N x d x 2 x 18 B x 2 servers x old+new), not keys: the
+    thresholded frontier is ~103 nodes steady (measured), but near the
+    LEAVES the ball-size-2 neighbourhoods multiply survivors ~4x (103 ->
+    421 hitters -> bucket 512), and that late-crawl spike is what sizes
+    memory — 320k clients OOMed around level 450 on exactly it; 196k is
+    the measured fit.  The run uses the STREAMING mode
+    (protocol/driver.py): keys live in host RAM (8 GB for both servers),
+    each level uploads only its ~40 B/client cw slice (double-buffered
+    behind the expands), and advance re-expands survivors chunk-wise
+    (collect.advance_from_cw) so the transient stays bounded.  Keygen runs
+    chunked on the chip and lands key chunks in host RAM as it goes.
+
+    Every number reported is measured wall-clock, INCLUDING the Python
+    client simulation, keygen + device->host key fetch, and per-level
+    host thresholding; per-level compile costs (first occurrence of each
+    bucket shape) are inside the e2e time, so the steady-state rate is
+    reported as the median level."""
+    import jax
+
+    from fuzzyheavyhitters_tpu.ops import ibdcf
+    from fuzzyheavyhitters_tpu.protocol import driver
+    from fuzzyheavyhitters_tpu.workloads import strings
+
+    t0 = time.perf_counter()
+    pts, _ = strings.zipf_workload(rng, sites, L, 1, zipf_exp, n, aug)
+    t_sim = time.perf_counter() - t0
+
+    # chunked keygen: the full cw tensor (2 x 9.4 GB) cannot sit on the
+    # chip next to the crawl; generate 32k clients at a time and fetch
+    host = lambda k: type(k)(*[np.asarray(x) for x in k])
+    t0 = time.perf_counter()
+    ch = 32768
+    parts = []
+    for i in range(0, n, ch):
+        k0c, k1c = ibdcf.gen_l_inf_ball(
+            pts[i : i + ch], ball, rng, engine=_keygen_engine()
+        )
+        parts.append((host(k0c), host(k1c)))
+        del k0c, k1c
+    cat = lambda xs: type(xs[0])(
+        *[np.concatenate([np.asarray(l) for l in leaves], axis=0)
+          for leaves in zip(*xs)]
+    )
+    k0 = cat([p[0] for p in parts])
+    k1 = cat([p[1] for p in parts])
+    del parts
+    t_keygen = time.perf_counter() - t0
+
+    s0, s1 = driver.make_servers(k0, k1)
+    lead = driver.Leader(
+        s0, s1, n_dims=1, data_len=L, f_max=1024, min_bucket=128,
+        stream=True, stream_chunk=32,
+    )
+    lead.tree_init()
+    t0 = time.perf_counter()
+    level_s = []
+    for lvl in range(L):
+        t1 = time.perf_counter()
+        n_alive = lead.run_level(lvl, nreqs=n, threshold=threshold)
+        level_s.append(time.perf_counter() - t1)
+        if lvl % 64 == 0:
+            print(f"level {lvl}: {n_alive} alive, "
+                  f"{level_s[-1]:.2f}s", flush=True)
+        if n_alive == 0:
+            break
+    dt = time.perf_counter() - t0
+    med = float(np.median(level_s))
+    return {
+        "n_clients": n,
+        "data_len": L,
+        "num_sites": sites,
+        "threshold": threshold,
+        "hitters": int(lead.n_nodes),
+        "crawl_seconds_e2e": round(dt, 1),
+        "clients_per_sec_e2e": round(n / dt, 1),
+        "ms_per_level_median": round(med * 1000, 1),
+        "clients_per_sec_steady": round(n / (med * L), 1),
+        "levels_run": len(level_s),
+        "f_bucket_steady": int(s0.frontier.f_bucket),
+        "client_sim_seconds": round(t_sim, 2),
+        "keygen_and_fetch_seconds": round(t_keygen, 1),
+        "host_key_gbytes_both_servers": round(
+            sum(np.asarray(x).nbytes for k in (k0, k1) for x in k) / 1e9, 2
+        ),
+    }
+
+
+def bench_covid(n=8192, L=64, n_counties=64, ball=1, threshold=0.01):
+    """COVID-geo workload end to end on the chip: the f64-bit domain
+    (data_len=64, n_dims=2 — ref: sample_covid_data.rs:32-35) through the
+    full driver crawl.  The reference's own covid call site is commented
+    out (leader.rs:367-371), so this is parity-plus: a hot-county centroid
+    file, jitterless sampling (same-county clients are bit-identical
+    f64s), counts exact.  Reports measured e2e wall including sampling."""
+    import os
+    import tempfile
+
+    import jax
+
+    from fuzzyheavyhitters_tpu.ops import ibdcf
+    from fuzzyheavyhitters_tpu.protocol import driver
+    from fuzzyheavyhitters_tpu.workloads import covid
+
+    rng = np.random.default_rng(11)
+    with tempfile.TemporaryDirectory() as td:
+        cpath = os.path.join(td, "county_centroids.csv")
+        with open(cpath, "w") as f:
+            f.write("fips_code,latitude,longitude\n")
+            for i in range(n_counties):
+                f.write(
+                    f"{10000 + i},{25 + 25 * rng.random():.4f},"
+                    f"{-120 + 50 * rng.random():.4f}\n"
+                )
+        t0 = time.perf_counter()
+        pts = covid.sample_covid_locations(
+            os.path.join(td, "absent.csv"), cpath, n, fuzz_factor=None, seed=7
+        )
+        k0, k1 = ibdcf.gen_l_inf_ball(pts, ball, rng, engine=_keygen_engine())
+        s0, s1 = driver.make_servers(k0, k1)
+        lead = driver.Leader(
+            s0, s1, n_dims=2, data_len=L, f_max=2048, min_bucket=64
+        )
+        res = lead.run(nreqs=n, threshold=threshold)
+        jax.block_until_ready(s0.frontier.states.bit)
+        dt = time.perf_counter() - t0
+    assert res.paths.shape[0] >= n_counties  # every hot county + ulp ball
+    return {
+        "covid_crawl_seconds_e2e": round(dt, 2),
+        "covid_clients_per_sec": round(n / dt, 1),
+        "n_clients": n,
+        "data_len": L,
+        "n_dims": 2,
+        "hitters": int(res.paths.shape[0]),
+    }
+
+
 async def _bring_up_pair(cfg, port):
     """Two collector servers + leader-side clients in this process:
     s1 first (it listens on the data plane at port+11), then s0 dials —
@@ -304,13 +457,14 @@ async def _bring_up_pair(cfg, port):
 def bench_secure(n=1024, L=12, port=39831):
     """Secure-mode aggregate crawl: both collector servers in one process
     with the REAL GC+OT data plane (secure_exchange=true), full level loop
-    over localhost sockets on the default device.  End-to-end wall time —
-    floored by ~6 serial device<->host fetches per level at the reported
-    ``device_fetch_rtt_ms`` (the tunnel's ~0.12 s), so it is a lower bound
-    on what adjacent hardware achieves; ``bench_secure_device`` is the
-    adjacent-chip number.  Batch amortization measured at n=8192: 146
-    clients/s (2.4x this config's rate) before payload transfer costs
-    take over.  Ref seam: collect.rs:419-482 inside tree_crawl."""
+    over localhost sockets on the default device.  End-to-end wall time.
+    The fused output-label b2a (secure.gb_step_fused) makes a level ONE
+    protocol round trip — ev u -> gb batch+cts — so the tunnel floor is
+    ~3 serial device<->host fetches per level (u, batch, shares) at the
+    reported ``device_fetch_rtt_ms`` (~0.12 s); round 4's two-round flow
+    measured ~10.  Still a lower bound on what adjacent hardware
+    achieves; ``bench_secure_device`` is the adjacent-chip number.
+    Ref seam: collect.rs:419-482 inside tree_crawl."""
     import asyncio
     import contextlib
     import io
@@ -379,43 +533,35 @@ def bench_secure(n=1024, L=12, port=39831):
     }
 
 
-def bench_secure_device(n=1024, L=12, f_bucket=16):
-    """Device-resident secure-crawl measurement: the WHOLE per-level 2PC —
-    both parties' expand, label extension, garbling, evaluation, b2a,
-    alive-gated share sums — as ONE jitted program on one chip, with the
-    four data-plane messages as in-program values.
+def bench_secure_device(n=65536, L=64, f_bucket=4, with_l512=True):
+    """Device-resident secure-crawl measurement at FLAGSHIP shape: the
+    WHOLE per-level 2PC — both parties' expand, label extension, garbling,
+    evaluation, output-label b2a (the fused flow the socket path ships),
+    alive-gated share sums — as ONE jitted program on one chip.
 
     This is the 1-chip stand-in for the 2-chip mesh deployment
-    (parallel/mesh.py runs the identical math with the messages as
-    ``ppermute`` transfers): it measures what the 2PC costs where the
-    north star runs it — chips adjacent to the servers — while
-    ``bench_secure`` measures the socket e2e, which through the remote
-    - chip tunnel is floored by ~0.12 s per device<->host round trip
-    (8-10 of them per level), not by the protocol."""
+    (parallel/mesh.py runs the same math with the messages as ``ppermute``
+    transfers): it measures what the 2PC costs where the north star runs
+    it — chips adjacent to the servers — while ``bench_secure`` measures
+    the socket e2e, which through the remote-chip tunnel is floored by
+    device<->host round trips, not by the protocol.  Shape: n >= 65k
+    clients, L >= 64, the steady zipf frontier bucket; ``with_l512`` adds
+    one level on data_len=512 keys (per-level 2PC cost is L-independent —
+    the measurement demonstrates it).  GC-table HBM bytes are reported
+    for the garbled batch + payload ciphertexts."""
     import jax
     import jax.numpy as jnp
 
     from fuzzyheavyhitters_tpu.ops import baseot, gc, ibdcf, otext
+    from fuzzyheavyhitters_tpu.ops import prg as prgmod
     from fuzzyheavyhitters_tpu.ops.fields import F255, FE62
     from fuzzyheavyhitters_tpu.protocol import collect, secure
 
     rng = np.random.default_rng(3)
-    sites = rng.integers(0, 1 << L, size=8)
-    pts = sites[rng.integers(0, 8, size=n)]
-    pts_bits = ((pts[:, None, None] >> np.arange(L - 1, -1, -1)) & 1) > 0
-    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng, engine=_keygen_engine())
     d = 1
     C, S = 1 << d, 2 * d
     B = f_bucket * C * n
     m = B * S
-
-    # steady-state frontier shape: f_bucket slots (root states replicated;
-    # the 2PC math is state-value-independent), all nodes+keys live so the
-    # gating work is fully exercised
-    f0 = collect.tree_init(k0, f_bucket)._replace(alive=jnp.ones(f_bucket, bool))
-    f1 = collect.tree_init(k1, f_bucket)._replace(alive=jnp.ones(f_bucket, bool))
-    alive_keys = jnp.ones(n, bool)
-    w = jnp.asarray(secure.alive_weight(np.ones(f_bucket, bool), np.ones(n, bool), C))
 
     s_bits = otext.fresh_s_bits()
     seeds0, seeds1, chosen = baseot.exchange(s_bits)
@@ -425,10 +571,24 @@ def bench_secure_device(n=1024, L=12, f_bucket=16):
     sa_rcv = jnp.asarray(seeds1.astype(np.uint32))
     gseed = jnp.asarray(np.frombuffer(b"bench-gc-seed..!", "<u4").copy())
     bseed = jnp.asarray(np.frombuffer(b"bench-b2aseed.!!", "<u4").copy())
-    derived = _prg.DERIVED_BITS
+
+    def make_keys(data_len):
+        sites = rng.integers(0, 2, size=(8, 1, data_len)).astype(bool)
+        pts_bits = sites[rng.integers(0, 8, size=n)]
+        k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng, engine=_keygen_engine())
+        # steady-state frontier: f_bucket slots (root states replicated;
+        # the 2PC math is state-value-independent), all nodes+keys live
+        f0 = collect.tree_init(k0, f_bucket)._replace(alive=jnp.ones(f_bucket, bool))
+        f1 = collect.tree_init(k1, f_bucket)._replace(alive=jnp.ones(f_bucket, bool))
+        return k0, k1, f0, f1
+
+    k0, k1, f0, f1 = make_keys(L)
+    alive_keys = jnp.ones(n, bool)
+    w = jnp.asarray(secure.alive_weight(np.ones(f_bucket, bool), np.ones(n, bool), C))
 
     def level_fn(field):
         limb = field.limb_shape
+        W = secure.payload_words(field)
 
         @jax.jit
         def run(keys0, fr0, keys1, fr1, lvl):
@@ -442,19 +602,18 @@ def bench_secure_device(n=1024, L=12, f_bucket=16):
             )
             q = otext._sender_extend(sm_snd, s_bits_d, u, off, m)
             s_block = otext.pack_bits(s_bits_d)
-            batch, mask = gc.garble_equality_delta(
-                s_block, q.reshape(B, S, 4), gseed, flat0
+            # fused output-label b2a (the socket flow's math, sans IO)
+            r_words = prgmod.stream_words(bseed, B * W).reshape(B, W)
+            r0 = field.sample(r_words)
+            r1 = field.add(r0, field.from_int(1))
+            w0, w1 = secure.field_to_words(field, r0), secure.field_to_words(field, r1)
+            batch, cts, _mask = gc.garble_equality_payload(
+                s_block, q.reshape(B, S, 4), gseed, flat0, w1, w0, W, 0
             )
-            e = gc.eval_equality(batch, t_rows.reshape(B, S, 4))
-            w_cols = -(-m // 32)
-            off2 = off + (-(-w_cols // 16))
-            u2, t2_rows = otext._receiver_extend(sm_rcv, sa_rcv, e, off2, B)
-            q2 = otext._sender_extend(sm_snd, s_bits_d, u2, off2, B)
-            idx0 = m
-            c0, c1, r1 = secure.b2a_encrypt(
-                field, q2, s_block, mask, bseed, idx0
+            _, pay = gc.eval_equality_payload(
+                batch, t_rows.reshape(B, S, 4), cts, W, 0
             )
-            v1 = secure.b2a_decrypt(field, t2_rows, idx0, c0, c1, e)
+            v1 = secure.words_to_field(field, pay)
             sh0 = secure.node_share_sums(
                 field, r1.reshape((f_bucket, C, n) + limb), w
             )
@@ -465,8 +624,6 @@ def bench_secure_device(n=1024, L=12, f_bucket=16):
 
         return run
 
-    import jax.numpy as jnp  # noqa: F811
-
     results = {}
     for name, field in (("fe62", FE62), ("f255", F255)):
         run = level_fn(field)
@@ -475,8 +632,8 @@ def bench_secure_device(n=1024, L=12, f_bucket=16):
         v = np.asarray(field.canon(field.sub(sh0, sh1)))
         counts = v[..., 0] if field is F255 else v
         masks = collect.pattern_masks(d)
-        p0, _ = collect.expand_share_bits(k0, f0, 0)
-        p1, _ = collect.expand_share_bits(k1, f1, 0)
+        p0, _ = collect.expand_share_bits(k0, f0, 0, want_children=False)
+        p1, _ = collect.expand_share_bits(k1, f1, 0, want_children=False)
         want = np.asarray(collect.counts_by_pattern(
             p0, p1, jnp.asarray(masks), alive_keys, jnp.ones(f_bucket, bool)
         ))
@@ -488,7 +645,23 @@ def bench_secure_device(n=1024, L=12, f_bucket=16):
             iters=32,
         )
         results[name] = best
+    out_extra = {}
+    if with_l512:
+        k0b, k1b, f0b, f1b = make_keys(512)
+        run = level_fn(FE62)
+        run(k0b, f0b, k1b, f1b, 100)  # warm/compile the L=512 key shapes
+        best512 = _steady_state_seconds(
+            lambda: run(k0b, f0b, k1b, f1b, 100),
+            lambda outs: int(sum(jnp.sum(jnp.asarray(o[0])[0, 0]) for o in outs)),
+            lambda o: int(jnp.sum(jnp.asarray(o[0])[0, 0])),
+            iters=16,
+        )
+        out_extra["secure_device_ms_per_level_fe62_L512_keys"] = round(
+            best512 * 1000, 3
+        )
     total = results["fe62"] * (L - 1) + results["f255"]
+    # garbled batch + payload ciphertexts resident per level (FE62 words)
+    gc_bytes = B * ((S - 1) * 2 * 16 + S * 16 + 4 + 2 * 4 * 4)
     return {
         "secure_device_clients_per_sec": round(n / total, 1),
         "secure_device_ms_per_level_fe62": round(results["fe62"] * 1000, 3),
@@ -498,6 +671,8 @@ def bench_secure_device(n=1024, L=12, f_bucket=16):
         "data_len": L,
         "f_bucket": f_bucket,
         "gc_tests_per_level": B,
+        "gc_batch_mbytes_per_level_fe62": round(gc_bytes / 1e6, 1),
+        **out_extra,
     }
 
 
@@ -672,6 +847,11 @@ def main():
         " np.random.default_rng(0))))",
         timeout_s=540,
     )
+    crawl_hbm_max = _subprocess_metric(
+        "import json, numpy as np, bench;"
+        "print(json.dumps(bench.bench_crawl_hbm_max(np.random.default_rng(17))))",
+        timeout_s=1740,  # a REAL 512-level run takes ~15-20 min e2e
+    )
     secure = _subprocess_metric(
         "import json, bench;"
         "print(json.dumps(bench.bench_secure()))",
@@ -685,6 +865,11 @@ def main():
     hbm = _subprocess_metric(
         "import json, bench;"
         "print(json.dumps(bench.bench_hbm()))",
+        timeout_s=540,
+    )
+    covid = _subprocess_metric(
+        "import json, bench;"
+        "print(json.dumps(bench.bench_covid()))",
         timeout_s=540,
     )
     hash_margin = _subprocess_metric(
@@ -713,9 +898,11 @@ def main():
                     "keygen_sweep": sweep,
                     "reference_key_bytes": BASELINE_KEY_BYTES,
                     "crawl": crawl,
+                    "crawl_hbm_max": crawl_hbm_max,
                     "secure_crawl": secure,
                     "secure_device": secure_device,
                     "hbm": hbm,
+                    "covid": covid,
                     "hash_margin": hash_margin,
                     "upload": upload,
                 },

@@ -22,14 +22,7 @@ from ..ops import ibdcf
 from ..protocol.leader_rpc import RpcLeader
 from ..protocol.rpc import CollectorClient
 from ..utils import config as configmod
-from ..workloads import covid, rides, strings
-
-AUG_LEN = 8  # per-request augmentation bits (ref: leader.rs:331)
-
-RIDES_CSV = "data/RideAustin_Weather.csv"
-COVID_CSV = "data/COVID-19_Case_Surveillance_Public_Use_Data_with_Geography_20250430.csv"
-CENTROIDS_CSV = "data/county_centroids.csv"
-OUTPUT_CSV = "data/ride_heavy_hitters.csv"
+from ..workloads import OUTPUT_CSV, rides, sample_points, strings
 
 
 def _split(addr: str) -> tuple[str, int]:
@@ -58,33 +51,6 @@ def keygen_report(cfg, rng, engine: str) -> None:
     print(f"Keygen engine: {engine}")
     print(f"Key size: {per_client} bytes")
     print(f"Generated {n} keys in {dt:.3f} seconds ({dt / n:.6f} sec/key)")
-
-
-def sample_points(cfg, nreqs: int, rng) -> np.ndarray:
-    """Distribution-selected client points -> bool[nreqs, n_dims, data_len]
-    (ref: leader.rs:332, 372)."""
-    if cfg.distribution == "zipf":
-        pts, _ = strings.zipf_workload(
-            rng, cfg.num_sites, cfg.data_len, cfg.n_dims, cfg.zipf_exponent, nreqs, AUG_LEN
-        )
-        return pts
-    if cfg.distribution == "rides":
-        assert cfg.data_len == 16 and cfg.n_dims == 2, "rides flow is i16 lat/lon"
-        coords = rides.load_or_synthesize_locations(RIDES_CSV, nreqs, seed=42)
-        from ..utils import bits as bitutils
-
-        return np.stack(
-            [
-                np.stack([bitutils.i16_to_ob_bits(int(v)) for v in row])
-                for row in coords
-            ]
-        )
-    if cfg.distribution == "covid":
-        assert cfg.data_len == 64 and cfg.n_dims == 2, "covid flow is f64-bit coords"
-        return covid.sample_covid_locations(
-            COVID_CSV, CENTROIDS_CSV, nreqs, fuzz_factor=float(AUG_LEN)
-        )
-    raise ValueError(f"unknown distribution {cfg.distribution!r}")
 
 
 async def amain() -> None:

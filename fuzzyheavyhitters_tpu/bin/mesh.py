@@ -9,6 +9,16 @@ parties and all client data parallelism live on a ``jax.sharding.Mesh``
 ``init_distributed``'s note; use the socket binaries when the two
 parties are separate administrative domains.
 
+All three workload distributions run here (zipf site strings, RideAustin
+i16 lat/lon, COVID f64-bit coords — the same shared sampler as the
+leader binary, so identical configs sample identical clients); the rides
+flow writes the same heavy-hitter CSV as the socket deployment.
+``malicious`` mode is a documented refusal: sketch verification needs
+Beaver-triple rounds between SEPARATE trust domains, and the mesh is one
+trust domain — its threat model already includes both parties, so run
+the socket binaries (which implement the full sketch+MPC path) when
+malicious clients are in scope.
+
 ::
 
     python -m fuzzyheavyhitters_tpu.bin.mesh --config configs/config.json -n 1000
@@ -21,6 +31,7 @@ on each host; process i supplies only party i's keys when N == 2
 from __future__ import annotations
 
 import argparse
+import os
 import time
 
 import numpy as np
@@ -28,9 +39,7 @@ import numpy as np
 from ..ops import ibdcf
 from ..parallel import mesh as meshmod
 from ..utils import config as configmod
-from ..workloads import strings
-
-AUG_LEN = 8  # per-request augmentation bits (ref: leader.rs:331)
+from ..workloads import OUTPUT_CSV, rides, sample_points
 
 
 def main() -> None:
@@ -49,6 +58,13 @@ def main() -> None:
                         "host-device mesh; must be set before backend init)")
     args = p.parse_args()
     cfg = configmod.load_config(args.config)
+    if cfg.malicious:
+        raise SystemExit(
+            "mesh binary: malicious mode refused — the mesh co-locates both "
+            "parties in one trust domain, so sketch verification adds no "
+            "security there; use the socket binaries (bin/server.py x2 + "
+            "bin/leader.py), which run the full sketch+MPC path."
+        )
 
     import jax
 
@@ -62,12 +78,7 @@ def main() -> None:
     rng = np.random.default_rng()
     n = args.num_requests
     print(f"{cfg.distribution} distribution sampling...")
-    if cfg.distribution != "zipf":
-        raise SystemExit("mesh binary ships the zipf workload; see bin/leader.py")
-    pts, _ = strings.zipf_workload(
-        rng, cfg.num_sites, cfg.data_len, cfg.n_dims, cfg.zipf_exponent, n,
-        AUG_LEN,
-    )
+    pts = sample_points(cfg, n, rng)
     t0 = time.perf_counter()
     k0, k1 = ibdcf.gen_l_inf_ball(
         pts, cfg.ball_size, rng, engine=ibdcf.best_engine(),
@@ -89,6 +100,11 @@ def main() -> None:
     print(f"Crawl done in {time.perf_counter() - t0:.2f}s")
     for row, c in zip(res.decode_ints(), res.counts):
         print(f"Final {row.tolist()} -> {int(c)}")
+    if cfg.distribution == "rides" and res.paths.shape[0]:
+        # identical CSV contract as the socket deployment (bin/leader.py)
+        os.makedirs(os.path.dirname(OUTPUT_CSV), exist_ok=True)
+        rides.save_heavy_hitters(res.paths, OUTPUT_CSV)
+        print(f"Wrote {res.paths.shape[0]} heavy hitters to {OUTPUT_CSV}")
 
 
 if __name__ == "__main__":

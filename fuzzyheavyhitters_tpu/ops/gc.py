@@ -43,6 +43,7 @@ OT, exactly the reference's wire-exchange split (equalitytest.rs:68-82,
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -182,13 +183,15 @@ def _carve_label_words(seed, B: int, S: int, n_label_sets: int, with_r: bool):
 
 
 def _garble_core(R, X0, Y0, mask, x_bits):
-    """Shared garbling core: labels + offset in, garbled batch out."""
+    """Shared garbling core: labels + offset in, (batch, output zero-labels)
+    out — ``out0`` is what payload delivery hashes (see
+    :func:`garble_equality_payload`)."""
     B = x_bits.shape[0]
     Z0 = X0 ^ Y0 ^ R  # XNOR relabel (free): Z0_i = X0_i ^ Y0_i ^ R
     out0, tables = _and_tree_garble(Z0, jnp.broadcast_to(R, (B, 4)))
     decode = _lsb(out0) ^ mask
     gb_labels = X0 ^ _maskw(x_bits, R)
-    return GarbledEqBatch(tables=tables, gb_labels=gb_labels, decode=decode)
+    return GarbledEqBatch(tables=tables, gb_labels=gb_labels, decode=decode), out0
 
 
 @jax.jit
@@ -208,7 +211,7 @@ def garble_equality(
     B, S = x_bits.shape
     # label material: R + X0[B,S] + Y0[B,S] labels + B mask bits
     R, (X0, Y0), mask = _carve_label_words(seed, B, S, 2, with_r=True)
-    batch = _garble_core(R, X0, Y0, mask, x_bits)
+    batch, _ = _garble_core(R, X0, Y0, mask, x_bits)
     return batch, GarblerSecrets(mask=mask, ev_label0=Y0, ev_label1=Y0 ^ R)
 
 
@@ -230,7 +233,7 @@ def garble_equality_delta(
     B, S = x_bits.shape
     _, (X0,), mask = _carve_label_words(seed, B, S, 1, with_r=False)
     R = jnp.asarray(R, jnp.uint32)
-    batch = _garble_core(R, X0, jnp.asarray(Y0, jnp.uint32), mask, x_bits)
+    batch, _ = _garble_core(R, X0, jnp.asarray(Y0, jnp.uint32), mask, x_bits)
     return batch, mask
 
 
@@ -244,3 +247,56 @@ def eval_equality(batch: GarbledEqBatch, ev_labels: jax.Array) -> jax.Array:
     z = batch.gb_labels ^ ev_labels  # active labels of the XNOR wires
     out = _and_tree_eval(z, batch.tables)
     return _lsb(out) ^ batch.decode
+
+
+@partial(jax.jit, static_argnames=("n_words",))
+def garble_equality_payload(R, Y0, seed, x_bits, m_v0, m_v1,
+                            n_words: int, idx_offset):
+    """:func:`garble_equality_delta` + payload delivery riding the OUTPUT
+    wire labels: the evaluator's garbled output label IS its 1-of-2 OT
+    choice, so the separate b2a OT round (and with it a full protocol
+    round trip) disappears.
+
+    m_v0/m_v1: uint32[B, n_words] — the payload the evaluator must learn
+    when the output wire carries semantic value 0 / 1 (value 1 = strings
+    equal).  Ciphertexts are indexed by the label's select (lsb) bit and
+    encrypted under ``H(out_label, idx)`` with the OT-domain hash — the
+    same circular-correlation-robustness assumption the Δ-OT pads already
+    rest on (labels differ by R = s).  ``idx_offset`` must be unique per
+    (session, batch) like any OT pad index; the caller uses the extension
+    session's consumed counter.
+
+    Returns (batch, cts uint32[2, B, n_words], mask bool[B]).
+    """
+    from .otext import ot_hash
+
+    x_bits = jnp.asarray(x_bits, bool)
+    B, S = x_bits.shape
+    _, (X0,), mask = _carve_label_words(seed, B, S, 1, with_r=False)
+    R = jnp.asarray(R, jnp.uint32)
+    batch, out0 = _garble_core(R, X0, jnp.asarray(Y0, jnp.uint32), mask, x_bits)
+    h0 = ot_hash(out0, n_words, idx_offset)  # pad for the v=0 label
+    h1 = ot_hash(out0 ^ R, n_words, idx_offset)
+    c_v0 = jnp.asarray(m_v0, jnp.uint32) ^ h0
+    c_v1 = jnp.asarray(m_v1, jnp.uint32) ^ h1
+    p = _lsb(out0)[:, None]  # select bit of the v=0 label
+    cts = jnp.stack([jnp.where(p, c_v1, c_v0), jnp.where(p, c_v0, c_v1)])
+    return batch, cts, mask
+
+
+@partial(jax.jit, static_argnames=("n_words",))
+def eval_equality_payload(batch: GarbledEqBatch, ev_labels, cts,
+                          n_words: int, idx_offset):
+    """Evaluate and open the output-label payload in one pass.
+
+    Returns (e bool[B] — the evaluator's XOR share, payload uint32[B,
+    n_words] — m_v for the actual output value v, which the evaluator
+    learns without learning v)."""
+    from .otext import ot_hash
+
+    z = batch.gb_labels ^ jnp.asarray(ev_labels, jnp.uint32)
+    out = _and_tree_eval(z, batch.tables)
+    s = _lsb(out)
+    pad = ot_hash(out, n_words, idx_offset)
+    ct = jnp.where(s[:, None], cts[1], cts[0])
+    return s ^ batch.decode, ct ^ pad

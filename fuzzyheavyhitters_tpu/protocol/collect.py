@@ -247,7 +247,30 @@ def expand_share_bits(
 @partial(jax.jit, static_argnames=("derived_bits", "want_children", "use_pallas"))
 def _expand_share_bits_jit(keys, frontier, level, derived_bits,
                            want_children=True, use_pallas=False):
-    cw_seed, cw_bits, cw_y = ibdcf.level_cw(keys, level)  # [N,d,2,(4|2)]
+    cw = ibdcf.level_cw(keys, level)  # [N,d,2,(4|2)] each
+    return _expand_body(cw, frontier, derived_bits, want_children, use_pallas)
+
+
+def expand_share_bits_from_cw(cw, frontier: Frontier, want_children: bool = True):
+    """:func:`expand_share_bits` for callers that hold the level's
+    correction words directly instead of a device key batch — the
+    HBM-overflow streaming mode (protocol/driver.py): keys live in host
+    RAM and only the current level's cw slice rides to the device.
+
+    ``cw`` = (cw_seed [N,d,2,4], cw_bits [N,d,2,2], cw_y [N,d,2,2]).
+    """
+    return _expand_cw_jit(
+        cw, frontier, prg.DERIVED_BITS, want_children, _expand_engine()
+    )
+
+
+@partial(jax.jit, static_argnames=("derived_bits", "want_children", "use_pallas"))
+def _expand_cw_jit(cw, frontier, derived_bits, want_children, use_pallas):
+    return _expand_body(cw, frontier, derived_bits, want_children, use_pallas)
+
+
+def _expand_body(cw, frontier, derived_bits, want_children, use_pallas):
+    cw_seed, cw_bits, cw_y = cw
     st = frontier.states
     if use_pallas:
         # plane-major fused kernel: pack, flags, and cw broadcast all live
@@ -431,6 +454,11 @@ def advance(
 def _advance_jit(keys, frontier, level, parent_idx, pattern_bits, n_alive,
                  derived_bits):
     cw = ibdcf.level_cw(keys, level)
+    return _advance_body(cw, frontier, parent_idx, pattern_bits, n_alive,
+                         derived_bits)
+
+
+def _advance_body(cw, frontier, parent_idx, pattern_bits, n_alive, derived_bits):
     st = frontier.states
     parents = jax.tree.map(lambda a: a[parent_idx], st)  # [F', N, d, 2]
     direction = jnp.broadcast_to(
@@ -439,6 +467,94 @@ def _advance_jit(keys, frontier, level, parent_idx, pattern_bits, n_alive,
     states = ibdcf._eval_bit_jit(cw, parents, direction, derived_bits)
     f_max = parent_idx.shape[0]
     alive = jnp.arange(f_max) < n_alive
+    return Frontier(states=states, alive=alive)
+
+
+def advance_from_cw(cw, frontier: Frontier, parent_idx, pattern_bits, n_alive,
+                    node_chunk: int | None = None) -> Frontier:
+    """Re-expanding advance from an explicit cw slice — the streaming-mode
+    twin of :func:`advance` (see :func:`expand_share_bits_from_cw`): the
+    caller already uploaded this level's correction words, and the crawl
+    runs WITHOUT a child cache (at wide frontiers the cache's
+    ``F x N x d x 2`` x 36 B footprint is what breaks the HBM budget, so
+    streaming crawls re-expand the survivors instead).
+
+    Under the planar engine the whole step stays plane-major (gather
+    surviving parents -> expand kernel -> direction select) — no layout
+    transposes ever touch the multi-GB frontier — and ``node_chunk``
+    bounds the transient: the child bucket is computed ``node_chunk``
+    parent slots at a time inside one jit (fori_loop + in-place dynamic
+    updates), so peak HBM is old frontier + new frontier + ONE chunk's
+    expansion instead of + a full-bucket child cache.  The parent
+    frontier is donated where XLA can use it.
+    """
+    F2 = parent_idx.shape[0]
+    if _expand_engine():
+        c = F2 if node_chunk is None else min(F2, node_chunk)
+        if F2 % c:
+            c = F2  # chunk must tile the bucket (both are powers of two)
+        return _advance_cw_planar_jit(
+            cw, frontier, parent_idx, pattern_bits, n_alive,
+            prg.DERIVED_BITS, c,
+        )
+    return _advance_cw_jit(
+        cw, frontier, parent_idx, pattern_bits, n_alive, prg.DERIVED_BITS
+    )
+
+
+@partial(jax.jit, static_argnames=("derived_bits",), donate_argnums=(1,))
+def _advance_cw_jit(cw, frontier, parent_idx, pattern_bits, n_alive,
+                    derived_bits):
+    return _advance_body(cw, frontier, parent_idx, pattern_bits, n_alive,
+                         derived_bits)
+
+
+@partial(jax.jit, static_argnames=("derived_bits", "chunk"), donate_argnums=(1,))
+def _advance_cw_planar_jit(cw, frontier, parent_idx, pattern_bits, n_alive,
+                           derived_bits, chunk):
+    st = frontier.states  # plane-major [4,d,2,F,N] / [d,2,F,N]
+    F2 = parent_idx.shape[0]
+    d = st.bit.shape[0]
+    N = st.bit.shape[-1]
+
+    def advance_slots(pidx, pbits):
+        c = pidx.shape[0]
+        par = EvalState(
+            seed=jnp.take(st.seed, pidx, axis=3),
+            bit=jnp.take(st.bit, pidx, axis=2),
+            y_bit=jnp.take(st.y_bit, pidx, axis=2),
+        )
+        gf = Frontier(states=par, alive=jnp.ones(c, bool))
+        _, children = _expand_body(cw, gf, derived_bits, True, True)
+        return _advance_children_jit(
+            children, jnp.arange(c), pbits, c, planar=True
+        ).states
+
+    if chunk == F2:
+        states = advance_slots(parent_idx, pattern_bits)
+    else:
+        def body(i, acc):
+            pidx = jax.lax.dynamic_slice_in_dim(parent_idx, i * chunk, chunk)
+            pbits = jax.lax.dynamic_slice_in_dim(
+                pattern_bits, i * chunk, chunk
+            )
+            ns = advance_slots(pidx, pbits)
+            upd = lambda a, u, ax: jax.lax.dynamic_update_slice_in_dim(
+                a, u, i * chunk, axis=ax
+            )
+            return EvalState(
+                seed=upd(acc.seed, ns.seed, 3),
+                bit=upd(acc.bit, ns.bit, 2),
+                y_bit=upd(acc.y_bit, ns.y_bit, 2),
+            )
+
+        init = EvalState(
+            seed=jnp.zeros((4, d, 2, F2, N), jnp.uint32),
+            bit=jnp.zeros((d, 2, F2, N), bool),
+            y_bit=jnp.zeros((d, 2, F2, N), bool),
+        )
+        states = jax.lax.fori_loop(0, F2 // chunk, body, init)
+    alive = jnp.arange(F2) < n_alive
     return Frontier(states=states, alive=alive)
 
 

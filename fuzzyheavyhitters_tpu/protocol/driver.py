@@ -27,6 +27,68 @@ from ..ops.ibdcf import IbDcfKeyBatch
 from . import collect
 
 
+def cw_window(keys: IbDcfKeyBatch, lo: int, hi: int):
+    """Host-side correction-word WINDOW [lo, hi) -> device upload,
+    LEVEL-MAJOR (``[W, N, d, 2, words]``).
+
+    For the STREAMING crawl mode: ``keys`` leaves are host numpy arrays
+    (the full ``cw_seed [N, d, 2, L, 4]`` never touches the device); the
+    crawl uploads ~20 B per (client, dim, side, level) in windows of
+    ``Leader.stream_window`` levels and slices each level ON DEVICE
+    (:func:`cw_at`).  Windowing matters twice over a remote-chip tunnel:
+    eight big transfers beat 512 small ones, and per-``device_put``
+    buffer churn in the remote runtime was measured to creep ~20 MB per
+    level until a 450-level crawl died of ResourceExhausted.  The
+    level-major transpose happens on the HOST so the per-level device
+    slice is one contiguous 13 MB view — slicing the natural
+    ``[..., W, words]`` layout instead was a strided gather over the
+    whole window and cost ~2 s/level on chip."""
+    import jax
+
+    take = lambda a: jax.device_put(
+        np.ascontiguousarray(np.moveaxis(np.asarray(a)[..., lo:hi, :], -2, 0))
+    )
+    return take(keys.cw_seed), take(keys.cw_bits), take(keys.cw_y_bits)
+
+
+_CW_AT = None
+
+
+def cw_at(window, idx: int):
+    """One level's cw triple out of a level-major device window (one
+    contiguous device slice — no host transfer)."""
+    global _CW_AT
+    if _CW_AT is None:
+        import jax
+        from jax import lax
+
+        @jax.jit
+        def take(win, i):
+            return tuple(
+                lax.dynamic_index_in_dim(a, i, axis=0, keepdims=False)
+                for a in win
+            )
+
+        _CW_AT = take
+    return _CW_AT(window, np.int32(idx))
+
+
+def slim_root_batch(keys: IbDcfKeyBatch) -> IbDcfKeyBatch:
+    """Root-only key batch for ``tree_init`` in streaming mode: real
+    root seeds + key_idx, zero-length correction-word axes (eval_init
+    touches only the roots; uploading the full cw tensors is exactly what
+    streaming exists to avoid)."""
+    root = np.asarray(keys.root_seed)
+    batch = root.shape[:-1]
+    return IbDcfKeyBatch(
+        key_idx=np.asarray(keys.key_idx),
+        root_seed=root,
+        cw_seed=np.zeros(batch + (0, 4), np.uint32),
+        cw_bits=np.zeros(batch + (0, 2), bool),
+        cw_y_bits=np.zeros(batch + (0, 2), bool),
+    )
+
+
 @dataclass
 class ServerState:
     """One collector server's state (ref: server.rs:44-52 wraps the same)."""
@@ -43,10 +105,19 @@ class CrawlResult:
     counts: np.ndarray  # uint32[H]
 
     def decode_ints(self) -> np.ndarray:
-        """paths -> int[H, d] leaf values (MSB-first per dim)."""
+        """paths -> int[H, d] leaf values (MSB-first per dim).
+
+        Domains of 63+ bits (the COVID f64-bit encoding is 64) overflow
+        an int64 weight vector, so wide paths decode through Python ints
+        (object dtype) — this is leader-side decoration, not a hot path."""
         L = self.paths.shape[-1]
-        weights = 1 << np.arange(L - 1, -1, -1)
-        return (self.paths.astype(np.int64) * weights).sum(-1)
+        if L < 63:
+            weights = 1 << np.arange(L - 1, -1, -1)
+            return (self.paths.astype(np.int64) * weights).sum(-1)
+        vals = np.zeros(self.paths.shape[:-1], dtype=object)
+        for i in range(L):
+            vals = (vals << 1) | self.paths[..., i].astype(object)
+        return vals
 
 
 @dataclass
@@ -59,16 +130,53 @@ class Leader:
     data_len: int
     f_max: int = 256
     min_bucket: int = 1  # pin >1 only on compile-bound test hosts
+    # STREAMING mode: keys stay in host RAM; each level uploads only its
+    # cw slice (double-buffered) and the crawl re-expands survivors
+    # instead of caching children — the regime for key batches / wide
+    # frontiers that exceed one chip's HBM (data_len=512 at >200k
+    # clients with both servers colocated).
+    stream: bool = False
+    # streaming-advance transient bound: parent slots expanded per chunk
+    # (None = whole bucket at once; set on HBM-bound runs, see
+    # collect.advance_from_cw)
+    stream_chunk: int | None = None
+    # cw upload window in levels (see cw_window); the next window is
+    # prefetched at the current window's entry so the transfer rides
+    # behind ~stream_window levels of compute
+    stream_window: int = 64
     # leader-side bookkeeping
     paths: np.ndarray = field(default=None)  # bool[F, d, level]
     n_nodes: int = 0
 
     def tree_init(self):
         for s in (self.server0, self.server1):
-            s.frontier = collect.tree_init(s.keys, self.min_bucket)
+            keys = slim_root_batch(s.keys) if self.stream else s.keys
+            s.frontier = collect.tree_init(keys, self.min_bucket)
             s.children = None
         self.paths = np.zeros((1, self.n_dims, 0), bool)
         self.n_nodes = 1
+        self._win = {}  # which -> (lo, window triple)
+        self._win_next = {}  # (which, lo) -> prefetched window triple
+
+    def _take_cw(self, which: int, level: int):
+        W = self.stream_window
+        lo = (level // W) * W
+        ent = self._win.get(which)
+        if ent is None or ent[0] != lo:
+            tri = self._win_next.pop((which, lo), None)
+            if tri is None:
+                keys = (self.server0, self.server1)[which].keys
+                tri = cw_window(keys, lo, min(lo + W, self.data_len))
+            self._win[which] = ent = (lo, tri)
+            # start the NEXT window's upload now — it arrives behind ~W
+            # levels of compute
+            nlo = lo + W
+            if nlo < self.data_len and (which, nlo) not in self._win_next:
+                keys = (self.server0, self.server1)[which].keys
+                self._win_next[(which, nlo)] = cw_window(
+                    keys, nlo, min(nlo + W, self.data_len)
+                )
+        return cw_at(ent[1], level - ent[0])
 
     def run_level(self, level: int, nreqs: int, threshold: float) -> int:
         """One crawl->threshold->prune round; returns surviving node count.
@@ -78,13 +186,23 @@ class Leader:
         """
         d = self.n_dims
         masks = collect.pattern_masks(d)
-        p0, ch0 = collect.expand_share_bits(
-            self.server0.keys, self.server0.frontier, level
-        )
-        p1, ch1 = collect.expand_share_bits(
-            self.server1.keys, self.server1.frontier, level
-        )
-        self.server0.children, self.server1.children = ch0, ch1
+        if self.stream:
+            cw0 = self._take_cw(0, level)
+            cw1 = self._take_cw(1, level)
+            p0, _ = collect.expand_share_bits_from_cw(
+                cw0, self.server0.frontier, want_children=False
+            )
+            p1, _ = collect.expand_share_bits_from_cw(
+                cw1, self.server1.frontier, want_children=False
+            )
+        else:
+            p0, ch0 = collect.expand_share_bits(
+                self.server0.keys, self.server0.frontier, level
+            )
+            p1, ch1 = collect.expand_share_bits(
+                self.server1.keys, self.server1.frontier, level
+            )
+            self.server0.children, self.server1.children = ch0, ch1
         counts = collect.counts_by_pattern(
             p0,
             p1,
@@ -102,11 +220,29 @@ class Leader:
         )
         pat_bits = collect.pattern_to_bits(pattern, d)
 
-        for s in (self.server0, self.server1):
-            s.frontier = collect.advance_from_children(
-                s.children, parent, pat_bits, n_alive
-            )
-            s.children = None
+        if self.stream:
+            del p0, p1  # frontier buffers are donated by advance_from_cw
+            if level < self.data_len - 1 and n_alive:
+                f0, f1 = self.server0.frontier, self.server1.frontier
+                self.server0.frontier = None  # drop refs before donation
+                self.server1.frontier = None
+                self.server0.frontier = collect.advance_from_cw(
+                    cw0, f0, parent, pat_bits, n_alive, self.stream_chunk
+                )
+                # free server 0's old frontier BEFORE server 1 advances:
+                # keeping both olds + both news alive is what overflows
+                # HBM at wide-frontier levels (four full frontiers)
+                del f0
+                self.server1.frontier = collect.advance_from_cw(
+                    cw1, f1, parent, pat_bits, n_alive, self.stream_chunk
+                )
+                del f1
+        else:
+            for s in (self.server0, self.server1):
+                s.frontier = collect.advance_from_children(
+                    s.children, parent, pat_bits, n_alive
+                )
+                s.children = None
 
         # leader-side path bookkeeping (child bit j = (pattern >> j) & 1)
         new_paths = np.zeros((n_alive, d, self.paths.shape[-1] + 1), bool)

@@ -420,9 +420,13 @@ class CollectorServer:
         alternates it per level — the reference's ``gc_sender`` flag,
         rpc.rs:20-23 — so garbling cost splits across the servers); each
         direction runs its own OT-extension session (``_setup_secure``).
-        Every data-plane message is ONE packed array: through a remote-chip
-        tunnel each device->host fetch is a full round trip, so fetch
-        count, not byte count, is the floor (see secure.pack_gc_batch)."""
+        Every data-plane message is ONE packed array, and the b2a payloads
+        ride the garbled batch under the OUTPUT wire labels
+        (secure.gb_step_fused), so a level is ONE protocol round trip —
+        ev u -> gb batch+cts — with exactly one device fetch per message:
+        through a remote-chip tunnel each fetch is a full round trip, so
+        fetch count, not byte count, is the floor.  (The reference runs
+        GC then a separate OT round here, collect.rs:419-482.)"""
         t0 = time.perf_counter()
         packed, self._children = collect.expand_share_bits(
             self.keys, self.frontier, level, want_children=not last
@@ -434,8 +438,9 @@ class CollectorServer:
         B = F_ * C * N
         self._gc_tests += B
         flat = strs.reshape(B, S)
-        jax.block_until_ready(flat)
-        t1 = time.perf_counter()
+        t1 = time.perf_counter()  # dispatch time only: the FSS expansion
+        # itself overlaps the exchange below (no sync — a
+        # block_until_ready here would cost a tunnel RTT)
         w = secure.alive_weight(self.frontier.alive, self.alive_keys, C)
         # crawl counter makes every garbling's randomness unique even if a
         # leader re-crawls a level without reset (seed reuse with a fixed
@@ -445,27 +450,18 @@ class CollectorServer:
         b2a_seed = secure.derive_seed(self._sec_seed, 2, level, self._crawl_ctr)
         if self.server_id == garbler:  # garbler + OT-extension sender
             u = await _recv(self._peer_reader)
-            batch, mask = secure.gb_step1(self._ot_snd, u, flat, gc_seed)
-            await _send(self._peer_writer, np.asarray(secure.pack_gc_batch(batch)))
-            u2 = await _recv(self._peer_reader)
-            c0, c1, vals = secure.gb_step2(
-                self._ot_snd, u2, mask, b2a_seed, count_field, garbler
+            msg, vals = secure.gb_step_fused(
+                self._ot_snd, u, flat, gc_seed, b2a_seed, count_field, garbler
             )
-            await _send(self._peer_writer, np.asarray(jnp.stack([c0, c1])))
+            await _send(self._peer_writer, np.asarray(msg))
         else:  # evaluator + OT receiver (inputs stay on device: each
             # np.asarray here would cost a full tunnel round trip)
-            u, t_rows = secure.ev_step1(self._ot_rcv, flat)
+            u, t_rows, idx0 = secure.ev_step1_fused(self._ot_rcv, flat)
             await _send(self._peer_writer, np.asarray(u))
             bmsg = await _recv(self._peer_reader)
-            batch = secure.unpack_gc_batch(jnp.asarray(bmsg), B, S)
-            e = secure.ev_step2(batch, t_rows, B, S)
-            u2, t2_rows, idx0 = secure.ev_step3(self._ot_rcv, e)
-            await _send(self._peer_writer, np.asarray(u2))
-            cts = jnp.asarray(await _recv(self._peer_reader))
-            vals = secure.ev_step4(
-                self._ot_rcv, t2_rows, idx0, cts[0], cts[1], e, count_field
+            vals = secure.ev_open_fused(
+                self._ot_rcv, t_rows, bmsg, B, S, count_field, idx0
             )
-        jax.block_until_ready(vals)
         t2 = time.perf_counter()
         vals = vals.reshape((F_, C, N) + count_field.limb_shape)
         shares = secure.node_share_sums(count_field, vals, jnp.asarray(w))

@@ -23,10 +23,13 @@ TPU-native shape: everything is batched device tensors —
 - per-node share sums are alive-gated field reductions on device
   (collect.rs:487-501's ``add_lazy`` loop as one ``field.sum``).
 
-The step functions here are sans-IO: protocol/rpc.py strings them over the
-data-plane socket (message flow: ev u-matrix → gb garbled batch → ev b2a
-u-matrix → gb ciphertexts — two round trips per level), and parallel/mesh.py
-runs the same math with ``ppermute`` transfers on the 2-chip axis.
+The step functions here are sans-IO.  protocol/rpc.py strings the FUSED
+flow over the data-plane socket (ev u-matrix → gb garbled batch with the
+b2a payloads riding the output labels — ONE round trip per level, see
+``gb_step_fused`` below); parallel/mesh.py runs the explicit two-round
+math (ev u → gb batch → ev b2a u → gb ciphertexts) with ``ppermute``
+transfers on the 2-chip axis, where an extra round costs microseconds,
+not tunnel RTTs.
 
 Wire-share semantics: the garbler's per-test share is ``r1 = r0 ± 1``
 (+1 when server 0 garbles, −1 when server 1 does — the garbler flips per
@@ -201,6 +204,72 @@ def ev_step4(rcv: otext.OtExtReceiver, t2_rows, idx0, c0, c1, e_bits, field):
 
 
 # ---------------------------------------------------------------------------
+# FUSED socket flow: the b2a payloads ride the GC output labels
+# ---------------------------------------------------------------------------
+#
+# The two-round flow above (ev u -> gb batch -> ev u2 -> gb ciphertexts)
+# follows the reference's GC-then-OT structure (collect.rs:419-482).  But
+# the evaluator's b2a choice bit is exactly its GC output share — and its
+# garbled OUTPUT LABEL already encodes that choice 1-of-2 (labels differ
+# by R with the select bit in the lsb).  Encrypting the two payloads under
+# the two possible output labels (ops/gc.garble_equality_payload) delivers
+# the b2a OT for free inside the garbled batch: ONE protocol round trip
+# per level (ev u -> gb batch+cts), one fetch fewer on each side — through
+# a remote-chip tunnel each removed fetch is a full ~0.1 s RTT.  Security
+# rests on the same circular-correlation-robust hash assumption as the
+# Δ-OT pads (labels differ by R = s); the mesh path keeps the explicit
+# two-round form (device-resident, RTT-free, and its collectives are
+# already minimal).
+
+
+def ev_step1_fused(rcv: otext.OtExtReceiver, y_flat):
+    """Evaluator round 1: like :func:`ev_step1` but also captures the
+    pre-extension consumed counter — the payload-pad index base both
+    sides must agree on (the garbler captures the same value)."""
+    idx0 = rcv.consumed
+    u, t = ev_step1(rcv, y_flat)
+    return u, t, idx0
+
+
+def gb_step_fused(snd: otext.OtExtSender, u_msg, x_flat, gc_seed, b2a_seed,
+                  field, garbler: int = 0):
+    """Garbler: extend the input-label Δ-OT, garble, and attach the b2a
+    payloads under the output labels — the whole level in one message.
+
+    Returns (packed message, vals — the garbler's additive shares
+    ``r1 = r0 ± 1`` by garbling side, as in :func:`b2a_encrypt`)."""
+    x_flat = jnp.asarray(x_flat, bool)
+    B, S = x_flat.shape
+    idx0 = snd.consumed
+    q = snd.extend(B * S, u_msg)
+    W = payload_words(field)
+    r_words = prg.stream_words(jnp.asarray(b2a_seed, jnp.uint32), B * W).reshape(B, W)
+    r0 = field.sample(r_words)
+    one = field.from_int(1)
+    r1 = field.sub(r0, one) if garbler else field.add(r0, one)
+    w0, w1 = field_to_words(field, r0), field_to_words(field, r1)
+    # v = 1 (strings equal) -> evaluator learns r0, else r1: the ordering
+    # of collect.rs:439-456 with the choice implicit in the output label
+    batch, cts, _ = gc.garble_equality_payload(
+        jnp.asarray(snd.s_block), q.reshape(B, S, 4), jnp.asarray(gc_seed),
+        x_flat, w1, w0, W, idx0,
+    )
+    return pack_gc_payload_batch(batch, cts), r1
+
+
+def ev_open_fused(rcv: otext.OtExtReceiver, t_rows, msg, B: int, S: int,
+                  field, idx0: int):
+    """Evaluator round 2: evaluate the batch and open the payload under
+    the output label -> field values [B] (r0 where equal, else r1)."""
+    W = payload_words(field)
+    batch, cts = unpack_gc_payload_batch(jnp.asarray(msg), B, S, W)
+    _, w = gc.eval_equality_payload(
+        batch, jnp.asarray(t_rows).reshape(B, S, 4), cts, W, idx0
+    )
+    return words_to_field(field, w)
+
+
+# ---------------------------------------------------------------------------
 # Wire packing: one buffer per message
 # ---------------------------------------------------------------------------
 #
@@ -231,6 +300,18 @@ def unpack_gc_batch(buf: jax.Array, B: int, S: int) -> gc.GarbledEqBatch:
         gb_labels=buf[nt : nt + nl].reshape(B, S, 4),
         decode=buf[nt + nl :] != 0,
     )
+
+
+@jax.jit
+def pack_gc_payload_batch(batch: gc.GarbledEqBatch, cts: jax.Array) -> jax.Array:
+    return jnp.concatenate([pack_gc_batch(batch), jnp.ravel(cts)])
+
+
+@partial(jax.jit, static_argnames=("B", "S", "W"))
+def unpack_gc_payload_batch(buf: jax.Array, B: int, S: int, W: int):
+    buf = jnp.asarray(buf)
+    base = B * (S - 1) * 2 * 4 + B * S * 4 + B
+    return unpack_gc_batch(buf[:base], B, S), buf[base:].reshape(2, B, W)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,43 @@
+"""Client workloads (ref: leader.rs:332-388 distribution selection)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+RIDES_CSV = "data/RideAustin_Weather.csv"
+COVID_CSV = "data/COVID-19_Case_Surveillance_Public_Use_Data_with_Geography_20250430.csv"
+CENTROIDS_CSV = "data/county_centroids.csv"
+OUTPUT_CSV = "data/ride_heavy_hitters.csv"
+
+AUG_LEN = 8  # zipf per-request augmentation bits (ref: leader.rs:331)
+
+
+def sample_points(cfg, nreqs: int, rng: np.random.Generator) -> np.ndarray:
+    """Distribution-selected client points -> bool[nreqs, n_dims, data_len]
+    (ref: leader.rs:332, 372) — shared by every deployment entry point
+    (bin/leader.py, bin/mesh.py) so the pod and socket shapes sample
+    identical clients from identical configs."""
+    from . import covid, rides, strings
+    from ..utils import bits as bitutils
+
+    if cfg.distribution == "zipf":
+        pts, _ = strings.zipf_workload(
+            rng, cfg.num_sites, cfg.data_len, cfg.n_dims, cfg.zipf_exponent,
+            nreqs, AUG_LEN,
+        )
+        return pts
+    if cfg.distribution == "rides":
+        assert cfg.data_len == 16 and cfg.n_dims == 2, "rides flow is i16 lat/lon"
+        coords = rides.load_or_synthesize_locations(RIDES_CSV, nreqs, seed=42)
+        return np.stack(
+            [
+                np.stack([bitutils.i16_to_ob_bits(int(v)) for v in row])
+                for row in coords
+            ]
+        )
+    if cfg.distribution == "covid":
+        assert cfg.data_len == 64 and cfg.n_dims == 2, "covid flow is f64-bit coords"
+        return covid.sample_covid_locations(
+            COVID_CSV, CENTROIDS_CSV, nreqs, fuzz_factor=float(AUG_LEN)
+        )
+    raise ValueError(f"unknown distribution {cfg.distribution!r}")

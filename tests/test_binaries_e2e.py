@@ -102,6 +102,52 @@ def test_binaries_end_to_end(tmp_path):
     assert got == want
 
 
+def test_mesh_binary_rides_matches_socket_csv(tmp_path):
+    """The pod entry point on the flagship rides workload writes the SAME
+    heavy-hitter CSV as the socket deployment on identical client points
+    (both sample seed-42 synthetic coords via the shared workloads
+    sampler)."""
+    cfg = dict(CFG)
+    del cfg["backend"]  # mesh binary pins its platform via --platform
+    cfg_path = tmp_path / "rides_mesh.json"
+    cfg_path.write_text(json.dumps(cfg))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+        + " --xla_backend_optimization_level=1"
+    ).strip()
+    out = subprocess.run(
+        [sys.executable, "-m", "fuzzyheavyhitters_tpu.bin.mesh",
+         "--config", str(cfg_path), "-n", str(N_REQS), "--platform", "cpu",
+         "--devices", "4"],
+        cwd=tmp_path, env=env, capture_output=True, text=True, timeout=540,
+    )
+    assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-2000:]
+    csv_path = tmp_path / "data" / "ride_heavy_hitters.csv"
+    assert csv_path.exists(), out.stdout[-2000:]
+    assert csv_path.read_text() == _expected_csv(tmp_path)
+
+
+def test_mesh_binary_refuses_malicious(tmp_path):
+    """malicious mode on the mesh is a DOCUMENTED refusal (one trust
+    domain — sketch verification adds nothing there; the socket binaries
+    carry the real path)."""
+    cfg = dict(CFG, malicious=True)
+    cfg_path = tmp_path / "mal.json"
+    cfg_path.write_text(json.dumps(cfg))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "fuzzyheavyhitters_tpu.bin.mesh",
+         "--config", str(cfg_path), "-n", "4", "--platform", "cpu"],
+        cwd=tmp_path, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode != 0
+    assert "malicious mode refused" in out.stderr
+
+
 def test_mesh_binary_smoke(tmp_path):
     """The pod-deployment entry point (bin/mesh.py) runs a zipf collection
     on the virtual 2x4 CPU mesh and prints heavy hitters."""

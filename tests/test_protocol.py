@@ -132,3 +132,118 @@ def test_bucket_for_and_compact_survivors():
     assert parent[:3].tolist() == [0, 2, 3]
     assert pattern[:3].tolist() == [1, 0, 1]
     assert parent[3] == 0 and pattern[3] == 0  # zero padding
+
+
+@pytest.mark.parametrize("on_chip", [False, True])
+def test_streamed_crawl_matches_resident(rng, on_chip):
+    """The HBM-overflow streaming mode (host-resident keys, per-level cw
+    upload, cache-free donated advance) produces the identical crawl as
+    the resident-key driver — on the CPU/XLA engine and, where a chip is
+    present, on the planar Pallas engine (which exercises the in-layout
+    gather -> kernel-expand -> select advance)."""
+    import jax
+
+    if on_chip and jax.devices()[0].platform != "tpu":
+        pytest.skip("needs a TPU backend")
+    # the module fixture pins CPU; the chip variant must override it back
+    ctx = jax.default_device(
+        jax.devices()[0] if on_chip else jax.devices("cpu")[0]
+    )
+    with ctx:
+        L, n, d = 12, 300, 1
+        centers = rng.integers(0, 1 << L, size=(5, d))
+        pts = np.clip(
+            centers[rng.integers(0, 5, size=n)]
+            + rng.integers(-2, 3, size=(n, d)),
+            0, (1 << L) - 1,
+        )
+        pts_bits = np.array(
+            [[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts]
+        )
+        k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng, engine="np")
+        host = lambda k: type(k)(*[np.asarray(x) for x in k])
+        s0, s1 = driver.make_servers(k0, k1)
+        res = driver.Leader(s0, s1, n_dims=d, data_len=L, f_max=128).run(
+            nreqs=n, threshold=0.05
+        )
+        t0, t1 = driver.make_servers(host(k0), host(k1))
+        res_s = driver.Leader(
+            t0, t1, n_dims=d, data_len=L, f_max=128, stream=True
+        ).run(nreqs=n, threshold=0.05)
+        np.testing.assert_array_equal(res.paths, res_s.paths)
+        np.testing.assert_array_equal(
+            np.asarray(res.counts), np.asarray(res_s.counts)
+        )
+        assert res.paths.shape[0] >= 1
+
+
+def test_covid_crawl_end_to_end(rng, tmp_path):
+    """COVID workload driven end to end: the f64-bit domain (data_len=64,
+    n_dims=2, ref: sample_covid_data.rs:32-35) through the full driver
+    crawl, checked against a direct interval oracle in u64 bit-space.
+    Jitterless sampling makes same-county clients bit-identical, so the
+    heavy hitters are each hot county's f64 pattern plus its L∞-ball
+    neighbourhood in ulp space."""
+    from fuzzyheavyhitters_tpu.workloads import covid
+
+    csv_path = tmp_path / "county_centroids.csv"
+    csv_path.write_text(
+        "fips_code,latitude,longitude\n"
+        "01001,32.53,-86.64\n"
+        "06037,34.05,-118.24\n"
+        "48453,30.26,-97.74\n"
+    )
+    n, L, ball = 24, 64, 1
+    pts = covid.sample_covid_locations(
+        str(tmp_path / "absent.csv"), str(csv_path), n,
+        fuzz_factor=None, seed=3,
+    )
+    assert pts.shape == (n, 2, L)
+    k0, k1 = ibdcf.gen_l_inf_ball(pts, ball, rng, engine="np")
+    s0, s1 = driver.make_servers(k0, k1)
+    lead = driver.Leader(
+        s0, s1, n_dims=2, data_len=L, f_max=64, min_bucket=64
+    )
+    threshold = 0.2  # thresh = max(1, 4)
+    res = lead.run(nreqs=n, threshold=threshold)
+    got = {
+        tuple(int(v) for v in res.decode_ints()[i]): int(res.counts[i])
+        for i in range(res.paths.shape[0])
+    }
+
+    # oracle: u64 interpretation of the f64 bit patterns; ball membership
+    # is a saturating per-dim interval test (utils/bits semantics)
+    ints = np.zeros((n, 2), np.uint64)
+    for i in range(n):
+        for d_ in range(2):
+            v = 0
+            for b in pts[i, d_]:
+                v = (v << 1) | int(b)
+            ints[i, d_] = v
+    lo = np.maximum(ints, ball) - ball  # saturating p - ball
+    hi = ints + ball
+    hi[hi < ints] = np.uint64(2**64 - 1)  # saturating p + ball
+    thresh = max(1, int(threshold * n))
+    cand = set()
+    for i in range(n):
+        for dx in range(-ball, ball + 1):
+            for dy in range(-ball, ball + 1):
+                x = int(ints[i, 0]) + dx
+                y = int(ints[i, 1]) + dy
+                if 0 <= x < 2**64 and 0 <= y < 2**64:
+                    cand.add((x, y))
+    want = {}
+    for x, y in cand:
+        c = int(np.sum((lo[:, 0] <= x) & (x <= hi[:, 0])
+                       & (lo[:, 1] <= y) & (y <= hi[:, 1])))
+        if c >= thresh:
+            want[(x, y)] = c
+    assert got == want
+    assert len(got) >= 3  # every hot county survives with its ulp ball
+    # decoded leaves round-trip to the sampled coordinates
+    lats = {round(covid.bool_vec_to_f64(pts[i, 0]), 2) for i in range(n)}
+    got_lats = {
+        round(covid.bool_vec_to_f64(bitutils.int_to_bits(64, x)), 2)
+        for (x, _) in got
+    }
+    assert got_lats <= {l for l in lats} | {32.53, 34.05, 30.26}

@@ -60,6 +60,38 @@ def test_pipeline_sans_io(ot_pair, rng, field):
         np.testing.assert_array_equal(diff, eq.astype(np.uint64))
 
 
+@pytest.mark.parametrize("field", [FE62, F255], ids=["FE62", "F255"])
+@pytest.mark.parametrize("garbler", [0, 1])
+def test_pipeline_fused_sans_io(ot_pair, rng, field, garbler):
+    """The FUSED flow (b2a payloads under the GC output labels — one
+    protocol round trip, secure.gb_step_fused/ev_open_fused): v0 - v1 ==
+    [x == y] per test REGARDLESS of which side garbles (the r1 = r0 ± 1
+    sign trick), exactly like the two-round flow it replaces."""
+    snd, rcv = ot_pair
+    B, S = 16, 33
+    x = rng.integers(0, 2, size=(B, S)).astype(bool)
+    y = x.copy()
+    flip = rng.integers(0, 2, size=B).astype(bool)
+    y[flip, rng.integers(0, S, size=B)[flip]] ^= True
+    eq = np.all(x == y, axis=1)
+
+    gc_seed = np.frombuffer(pysecrets.token_bytes(16), "<u4")
+    b2a_seed = np.frombuffer(pysecrets.token_bytes(16), "<u4")
+    u, t_rows, idx0 = secure.ev_step1_fused(rcv, y)
+    msg, v_gb = secure.gb_step_fused(
+        snd, np.asarray(u), x, gc_seed, b2a_seed, field, garbler
+    )
+    v_ev = secure.ev_open_fused(rcv, t_rows, np.asarray(msg), B, S, field, idx0)
+    v0, v1 = (v_gb, v_ev) if garbler == 0 else (v_ev, v_gb)
+    diff = np.asarray(field.canon(field.sub(v0, v1)))
+    want = eq.astype(np.uint64)
+    if field is F255:
+        np.testing.assert_array_equal(diff[:, 0], want.astype(np.uint32))
+        assert not diff[:, 1:].any()
+    else:
+        np.testing.assert_array_equal(diff, want)
+
+
 def test_evaluator_share_is_masked(ot_pair, rng):
     """The evaluator's GC output alone must not reveal equality: its share
     differs from the plaintext wherever the garbler's mask bit is set."""
