@@ -10,9 +10,10 @@ parties and all client data parallelism live on a ``jax.sharding.Mesh``
 parties are separate administrative domains.
 
 All three workload distributions run here (zipf site strings, RideAustin
-i16 lat/lon, COVID f64-bit coords — the same shared sampler as the
-leader binary, so identical configs sample identical clients); the rides
-flow writes the same heavy-hitter CSV as the socket deployment.
+i16 lat/lon, COVID f64-bit coords) via the same shared sampler as the
+leader binary; the rides flow is deterministically sampled (seed 42, as
+in the leader) and writes the same heavy-hitter CSV as the socket
+deployment on the same config.
 ``malicious`` mode is a documented refusal: sketch verification needs
 Beaver-triple rounds between SEPARATE trust domains, and the mesh is one
 trust domain — its threat model already includes both parties, so run

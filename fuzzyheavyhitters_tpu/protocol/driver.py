@@ -21,7 +21,9 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+import jax
 import numpy as np
+from jax import lax
 
 from ..ops.ibdcf import IbDcfKeyBatch
 from . import collect
@@ -43,34 +45,23 @@ def cw_window(keys: IbDcfKeyBatch, lo: int, hi: int):
     slice is one contiguous 13 MB view — slicing the natural
     ``[..., W, words]`` layout instead was a strided gather over the
     whole window and cost ~2 s/level on chip."""
-    import jax
-
     take = lambda a: jax.device_put(
         np.ascontiguousarray(np.moveaxis(np.asarray(a)[..., lo:hi, :], -2, 0))
     )
     return take(keys.cw_seed), take(keys.cw_bits), take(keys.cw_y_bits)
 
 
-_CW_AT = None
+@jax.jit
+def _cw_at(window, i):
+    return tuple(
+        lax.dynamic_index_in_dim(a, i, axis=0, keepdims=False) for a in window
+    )
 
 
 def cw_at(window, idx: int):
     """One level's cw triple out of a level-major device window (one
     contiguous device slice — no host transfer)."""
-    global _CW_AT
-    if _CW_AT is None:
-        import jax
-        from jax import lax
-
-        @jax.jit
-        def take(win, i):
-            return tuple(
-                lax.dynamic_index_in_dim(a, i, axis=0, keepdims=False)
-                for a in win
-            )
-
-        _CW_AT = take
-    return _CW_AT(window, np.int32(idx))
+    return _cw_at(window, np.int32(idx))
 
 
 def slim_root_batch(keys: IbDcfKeyBatch) -> IbDcfKeyBatch:

@@ -14,9 +14,11 @@ AUG_LEN = 8  # zipf per-request augmentation bits (ref: leader.rs:331)
 
 def sample_points(cfg, nreqs: int, rng: np.random.Generator) -> np.ndarray:
     """Distribution-selected client points -> bool[nreqs, n_dims, data_len]
-    (ref: leader.rs:332, 372) — shared by every deployment entry point
-    (bin/leader.py, bin/mesh.py) so the pod and socket shapes sample
-    identical clients from identical configs."""
+    (ref: leader.rs:332, 372) — the one sampling pipeline shared by every
+    deployment entry point (bin/leader.py, bin/mesh.py).  The rides flow
+    is deterministic (internal seed 42, like the reference's seeded
+    sampler), so rides outputs are comparable across deployments; zipf
+    and covid draw from the caller's ``rng``."""
     from . import covid, rides, strings
     from ..utils import bits as bitutils
 
