@@ -53,6 +53,20 @@ from . import prg
 
 LABEL_WORDS = 4  # 128-bit labels
 
+# Engine for the payload garble/eval pair (the hot ops of every secure
+# deployment path): True (the default on real chips) routes them through
+# the fused word-planar Pallas kernels (ops/gc_pallas.py) — BIT-EXACT
+# with the XLA form (the garbler's labels come from the same stream
+# draw), so the wire format and every test vector are engine-independent.
+# False (and any CPU host — no Mosaic there) keeps the XLA programs.
+GC_PALLAS: bool = True
+
+
+def _pallas_engine() -> bool:
+    from ..utils import effective_platform
+
+    return GC_PALLAS and effective_platform() != "cpu"
+
 # hash-tweak constants (words 2/3 of the tweak block): arbitrary fixed
 # odd constants so GC hashing never collides with the PRG's other uses
 _TWEAK2 = 0x9E3779B9
@@ -249,9 +263,37 @@ def eval_equality(batch: GarbledEqBatch, ev_labels: jax.Array) -> jax.Array:
     return _lsb(out) ^ batch.decode
 
 
-@partial(jax.jit, static_argnames=("n_words",))
 def garble_equality_payload(R, Y0, seed, x_bits, m_v0, m_v1,
                             n_words: int, idx_offset):
+    """Engine dispatcher — the fused Pallas kernel on a real chip (module
+    flag ``GC_PALLAS``), the XLA program otherwise; outputs are bit-exact
+    either way.  See :func:`_garble_equality_payload_xla` for semantics."""
+    if jnp.asarray(x_bits).shape[1] >= 2 and _pallas_engine():
+        from . import gc_pallas
+
+        return gc_pallas.garble_equality_payload(
+            R, Y0, seed, x_bits, m_v0, m_v1, n_words, idx_offset
+        )
+    return _garble_equality_payload_xla(
+        R, Y0, seed, x_bits, m_v0, m_v1, n_words, idx_offset
+    )
+
+
+def eval_equality_payload(batch: GarbledEqBatch, ev_labels, cts,
+                          n_words: int, idx_offset):
+    """Engine dispatcher twin of :func:`garble_equality_payload`."""
+    if batch.gb_labels.shape[1] >= 2 and _pallas_engine():
+        from . import gc_pallas
+
+        return gc_pallas.eval_equality_payload(
+            batch, ev_labels, cts, n_words, idx_offset
+        )
+    return _eval_equality_payload_xla(batch, ev_labels, cts, n_words, idx_offset)
+
+
+@partial(jax.jit, static_argnames=("n_words",))
+def _garble_equality_payload_xla(R, Y0, seed, x_bits, m_v0, m_v1,
+                                 n_words: int, idx_offset):
     """:func:`garble_equality_delta` + payload delivery riding the OUTPUT
     wire labels: the evaluator's garbled output label IS its 1-of-2 OT
     choice, so the separate b2a OT round (and with it a full protocol
@@ -285,8 +327,8 @@ def garble_equality_payload(R, Y0, seed, x_bits, m_v0, m_v1,
 
 
 @partial(jax.jit, static_argnames=("n_words",))
-def eval_equality_payload(batch: GarbledEqBatch, ev_labels, cts,
-                          n_words: int, idx_offset):
+def _eval_equality_payload_xla(batch: GarbledEqBatch, ev_labels, cts,
+                               n_words: int, idx_offset):
     """Evaluate and open the output-label payload in one pass.
 
     Returns (e bool[B] — the evaluator's XOR share, payload uint32[B,
