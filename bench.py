@@ -111,12 +111,21 @@ def _throughput(jnp, gen, seeds_d, alpha_d, side_d, n, iters=20, trials=3):
     return n / best, k0
 
 
-def bench_keygen(jax, jnp, ibdcf, rng, sweep=(64, 128, 256, 512, 1024), n=8192):
+def bench_keygen(jax, jnp, ibdcf, rng, sweep=(64, 128, 256, 512, 1024)):
     from fuzzyheavyhitters_tpu.ops.keygen_pallas import gen_pair_pallas
 
     rows = {}
     headline = None
     for L in sweep:
+        # PRODUCTION-shaped batches: the leader generates keys 32k-128k at
+        # a time (bench_crawl_hbm_max, bin/leader.py's report).  Small
+        # batches measure the tunnel's per-launch dispatch overhead, not
+        # the kernel — observed to swing 1-15 ms by day, which at n=8192
+        # (5.8 ms of kernel work) once read as a 3x kernel "regression".
+        # n and the queue depth are sized to keep <= ~4 GB of queued key
+        # outputs (~20 B x n x L per launch) in HBM next to the inputs.
+        n = 32768 if L >= 1024 else 131072
+        iters = 6 if L >= 1024 else (3 if L >= 512 else 4)
         alpha = rng.integers(0, 2, size=(n, L)).astype(bool)
         seeds = rng.integers(0, 2**32, size=(n, 2, 4), dtype=np.uint32)
         side = np.ones(n, bool)
@@ -124,7 +133,7 @@ def bench_keygen(jax, jnp, ibdcf, rng, sweep=(64, 128, 256, 512, 1024), n=8192):
 
         keys_per_sec, k0 = _throughput(
             jnp, gen_pair_pallas, seeds_d, alpha_d, side_d, n,
-            iters=64,  # deep queue: amortize the end-of-batch fetch RTT
+            iters=iters,
             trials=6 if L == 512 else 3,  # headline: more min-of-trials
             # insurance against the tunnel's cross-run queueing variance
         )
@@ -133,19 +142,20 @@ def bench_keygen(jax, jnp, ibdcf, rng, sweep=(64, 128, 256, 512, 1024), n=8192):
             "keys_per_sec": round(keys_per_sec, 1),
             "us_per_key": round(1e6 / keys_per_sec, 3),
             "key_bytes": _key_wire_bytes(k0),
+            "n": n,
             "vs_baseline": round(keys_per_sec / (1e6 / base), 2) if base else None,
         }
         if L == 512:  # headline size: also compare the scan engine (each
             # extra engine compile costs ~30 s through the tunnel)
             scan_kps, _ = _throughput(
-                jnp, ibdcf.gen_pair, seeds_d, alpha_d, side_d, n, iters=5
+                jnp, ibdcf.gen_pair, seeds_d, alpha_d, side_d, n, iters=3
             )
             rows[L]["scan_engine_keys_per_sec"] = round(scan_kps, 1)
             headline = keys_per_sec
     return headline, rows
 
 
-def write_keygen_csv(rows: dict, n: int, path: str = "ibDCFbench_tpu.csv"):
+def write_keygen_csv(rows: dict, path: str = "ibDCFbench_tpu.csv"):
     """Emit the sweep in the shape of the reference's one shipped benchmark
     artifact (ibDCFbench.rs:57-68 -> ibDCFbench.csv: string_length,
     number_keys, time, avg_time, size)."""
@@ -154,6 +164,7 @@ def write_keygen_csv(rows: dict, n: int, path: str = "ibDCFbench_tpu.csv"):
         for L in sorted(rows):
             r = rows[L]
             avg = 1.0 / r["keys_per_sec"]
+            n = r["n"]
             f.write(f"{L},{n},{avg * n},{avg},{r['key_bytes']}\n")
 
 
@@ -280,6 +291,14 @@ def bench_crawl(ibdcf, driver, rng, n=131072, L=512, f_max=64):
         "f_bucket_steady": int(f_bucket),
         "levels_per_sec": round(L / dt, 2),
         "projected_1m_clients_seconds_1chip": round(dt * (1_000_000 / n), 1),
+        # the north star (BASELINE.json): clients are data-parallel over the
+        # mesh's `data` axis (parallel/mesh.py) — per-level cross-chip
+        # traffic is one psum of the [F, 2^d] count shares, microseconds
+        # against an 8+ ms level — so the 8-chip number is the 1-chip
+        # per-client cost / 8 (sharding validated by the multichip dryrun)
+        "projected_1m_clients_seconds_v5e8": round(
+            dt * (1_000_000 / n) / 8, 1
+        ),
     }
 
 
@@ -624,6 +643,19 @@ def bench_secure_device(n=65536, L=64, f_bucket=4, with_l512=True):
 
         return run
 
+    def _lvl_seconds(run_fn, *args, iters=32):
+        """Steady-state s/level: one dependent fetch over the first output
+        leaf of every queued launch (see _steady_state_seconds)."""
+        first = lambda o: jnp.ravel(
+            jax.tree_util.tree_leaves(o)[0]
+        )[0].astype(jnp.uint64)
+        return _steady_state_seconds(
+            lambda: run_fn(*args),
+            lambda outs: int(sum(first(o) for o in outs)),
+            lambda o: int(first(o)),
+            iters=iters,
+        )
+
     # engine A/B on the hot pair (gc.GC_PALLAS): XLA first, the fused
     # Pallas default LAST so the headline numbers come from the default
     # engine's run (the crawl bench's convention — only back-to-back
@@ -636,12 +668,7 @@ def bench_secure_device(n=65536, L=64, f_bucket=4, with_l512=True):
         try:
             run_x = level_fn(FE62)
             run_x(k0, f0, k1, f1, 0)  # warm/compile
-            best_xla_gc = _steady_state_seconds(
-                lambda: run_x(k0, f0, k1, f1, 0),
-                lambda outs: int(sum(jnp.sum(jnp.asarray(o[0])[0, 0]) for o in outs)),
-                lambda o: int(jnp.sum(jnp.asarray(o[0])[0, 0])),
-                iters=32,
-            )
+            best_xla_gc = _lvl_seconds(run_x, k0, f0, k1, f1, 0)
         finally:
             gcmod.GC_PALLAS = True
 
@@ -659,13 +686,7 @@ def bench_secure_device(n=65536, L=64, f_bucket=4, with_l512=True):
             p0, p1, jnp.asarray(masks), alive_keys, jnp.ones(f_bucket, bool)
         ))
         assert np.array_equal(counts.astype(np.uint64), want.astype(np.uint64))
-        best = _steady_state_seconds(
-            lambda: run(k0, f0, k1, f1, 0),
-            lambda outs: int(sum(jnp.sum(jnp.asarray(o[0])[0, 0]) for o in outs)),
-            lambda o: int(jnp.sum(jnp.asarray(o[0])[0, 0])),
-            iters=32,
-        )
-        results[name] = best
+        results[name] = _lvl_seconds(run, k0, f0, k1, f1, 0)
     out_extra = {}
     if best_xla_gc is not None:
         out_extra["secure_device_ms_per_level_fe62_xla_gc"] = round(
@@ -678,15 +699,30 @@ def bench_secure_device(n=65536, L=64, f_bucket=4, with_l512=True):
         k0b, k1b, f0b, f1b = make_keys(512)
         run = level_fn(FE62)
         run(k0b, f0b, k1b, f1b, 100)  # warm/compile the L=512 key shapes
-        best512 = _steady_state_seconds(
-            lambda: run(k0b, f0b, k1b, f1b, 100),
-            lambda outs: int(sum(jnp.sum(jnp.asarray(o[0])[0, 0]) for o in outs)),
-            lambda o: int(jnp.sum(jnp.asarray(o[0])[0, 0])),
-            iters=16,
-        )
+        best512 = _lvl_seconds(run, k0b, f0b, k1b, f1b, 100, iters=16)
         out_extra["secure_device_ms_per_level_fe62_L512_keys"] = round(
             best512 * 1000, 3
         )
+    # trusted-mode comparator at the SAME shape (both expands + plaintext
+    # pattern counts — what secure mode replaces with GC+OT), so the
+    # secure-vs-trusted cost ratio is explicit and same-run
+    masks = jnp.asarray(collect.pattern_masks(d))
+    a_keys = jnp.ones(n, bool)
+    a_nodes = jnp.ones(f_bucket, bool)
+
+    @jax.jit
+    def trusted_level(keys0, fr0, keys1, fr1, lvl):
+        p0, _ = collect.expand_share_bits(keys0, fr0, lvl, want_children=False)
+        p1, _ = collect.expand_share_bits(keys1, fr1, lvl, want_children=False)
+        return collect.counts_by_pattern(p0, p1, masks, a_keys, a_nodes)
+
+    trusted_level(k0, f0, k1, f1, 0)
+    best_trusted = _lvl_seconds(trusted_level, k0, f0, k1, f1, 0)
+    out_extra["trusted_same_shape_ms_per_level"] = round(best_trusted * 1000, 3)
+    out_extra["secure_over_trusted_ratio"] = round(
+        results["fe62"] / best_trusted, 2
+    )
+
     total = results["fe62"] * (L - 1) + results["f255"]
     # garbled batch + payload ciphertexts resident per level (FE62 words)
     gc_bytes = B * ((S - 1) * 2 * 16 + S * 16 + 4 + 2 * 4 * 4)
@@ -914,7 +950,7 @@ def main():
         timeout_s=540,
     )
     try:
-        write_keygen_csv(sweep, 8192)
+        write_keygen_csv(sweep)
     except Exception:
         pass
 

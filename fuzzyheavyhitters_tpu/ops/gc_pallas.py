@@ -264,18 +264,16 @@ def _garble_planar(R, Y0, X0, mask, x_bits, m_v0, m_v1, idx_offset,
         in_specs=[sc_spec,
                   spec(4 * S), spec(4 * S), spec(S), spec(1),
                   spec(W), spec(W)],
-        out_specs=[spec(max(n_tab, 1)), spec(4 * S), spec(1), spec(2 * W)],
+        out_specs=[spec(n_tab), spec(4 * S), spec(1), spec(2 * W)],
         out_shape=[
-            jax.ShapeDtypeStruct((max(n_tab, 1), rows, SUB, LANES), jnp.uint32),
+            jax.ShapeDtypeStruct((n_tab, rows, SUB, LANES), jnp.uint32),
             jax.ShapeDtypeStruct((4 * S, rows, SUB, LANES), jnp.uint32),
             jax.ShapeDtypeStruct((1, rows, SUB, LANES), jnp.uint32),
             jax.ShapeDtypeStruct((2 * W, rows, SUB, LANES), jnp.uint32),
         ],
         interpret=interpret,
     )(sc, *ops)
-    tables = _unplanarize(outs[0], B).reshape(B, max(S - 1, 0) or 1, 2, 4)
-    if S == 1:  # no AND gates: empty tree-order table (gc contract)
-        tables = tables[:, :0]
+    tables = _unplanarize(outs[0], B).reshape(B, S - 1, 2, 4)
     gb_labels = _unplanarize(outs[1], B).reshape(B, S, 4)
     decode = _unplanarize(outs[2], B).reshape(B) != 0
     cts = _unplanarize(outs[3], B).reshape(B, 2, W).transpose(1, 0, 2)
@@ -296,11 +294,10 @@ def _eval_planar(tables, gb_labels, decode, ev_labels, cts, idx_offset,
 
     sc = jnp.asarray(idx_offset, jnp.uint32).reshape(1)
     n_tab = (S - 1) * 2 * 4
-    tab_in = tables if S > 1 else jnp.zeros((B, 1, 2, 4), jnp.uint32)
     ops = [
         _planarize(gb_labels, B, bp),
         _planarize(ev_labels, B, bp),
-        _planarize(tab_in, B, bp),
+        _planarize(tables, B, bp),
         _planarize(jnp.asarray(decode, jnp.uint32), B, bp),
         _planarize(jnp.transpose(jnp.asarray(cts, jnp.uint32), (1, 0, 2)),
                    B, bp),
@@ -313,7 +310,7 @@ def _eval_planar(tables, gb_labels, decode, ev_labels, cts, idx_offset,
         partial(_eval_kernel, S, W),
         grid=(rows // R_BLK,),
         in_specs=[sc_spec,
-                  spec(4 * S), spec(4 * S), spec(max(n_tab, 1)), spec(1),
+                  spec(4 * S), spec(4 * S), spec(n_tab), spec(1),
                   spec(2 * W)],
         out_specs=[spec(1), spec(W)],
         out_shape=[
@@ -336,6 +333,9 @@ def garble_equality_payload(R, Y0, seed, x_bits, m_v0, m_v1,
     are word-for-word identical to the XLA engine's."""
     x_bits = jnp.asarray(x_bits, bool)
     B, S = x_bits.shape
+    if S < 2:  # S=1 has no AND gates; the XLA form covers it (gc.py's
+        # dispatcher never routes it here)
+        raise ValueError("gc_pallas requires S >= 2 wire strings")
     _, (X0,), mask = gc._carve_label_words(seed, B, S, 1, with_r=False)
     batch, cts = _garble_planar(
         jnp.asarray(R, jnp.uint32), jnp.asarray(Y0, jnp.uint32), X0, mask,
@@ -349,6 +349,8 @@ def eval_equality_payload(batch: gc.GarbledEqBatch, ev_labels, cts,
                           n_words: int, idx_offset, interpret: bool = False):
     """Drop-in for :func:`gc.eval_equality_payload` — bit-exact."""
     B, S = batch.gb_labels.shape[:2]
+    if S < 2:
+        raise ValueError("gc_pallas requires S >= 2 wire strings")
     return _eval_planar(
         jnp.asarray(batch.tables, jnp.uint32),
         jnp.asarray(batch.gb_labels, jnp.uint32),

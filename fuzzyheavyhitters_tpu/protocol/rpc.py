@@ -78,6 +78,16 @@ async def _recv(reader: asyncio.StreamReader):
     return pickle.loads(await reader.readexactly(n))
 
 
+async def _fetch(x) -> np.ndarray:
+    """Device->host fetch OFF the event loop.  A bare ``np.asarray`` on a
+    device array blocks the whole loop for a full transfer (a ~110 ms RTT
+    on remote-chip tunnels) — serializing the two servers' fetches when
+    they share a process (the in-process bench/tests) and starving
+    keepalives/concurrent verbs in any deployment.  np.asarray of distinct
+    arrays is thread-safe in JAX; the GIL releases during the copy."""
+    return await asyncio.to_thread(np.asarray, x)
+
+
 def _mask_words(level: int, n: int, blocks_for: int) -> np.ndarray:
     """Shared pseudorandom mask words for one level (both servers derive the
     same stream, so shares cancel on reconstruction).  Host NumPy on
@@ -388,7 +398,7 @@ class CollectorServer:
         packed, self._children = collect.expand_share_bits(
             self.keys, self.frontier, level, want_children=not last
         )
-        packed_np = np.asarray(packed)  # forces the device work to finish
+        packed_np = await _fetch(packed)  # forces the device work to finish
         t1 = time.perf_counter()
         # data plane: swap packed share bits with the peer server
         peer = await self._swap(packed_np)
@@ -397,7 +407,7 @@ class CollectorServer:
         counts = collect.counts_by_pattern(
             packed, peer, masks, self.alive_keys, self.frontier.alive
         )
-        counts = np.asarray(counts)
+        counts = await _fetch(counts)
         t3 = time.perf_counter()
         # per-level phase taxonomy of the reference (collect.rs:412-503);
         # trusted mode's "GC and OT" slot is the plaintext exchange
@@ -453,11 +463,11 @@ class CollectorServer:
             msg, vals = secure.gb_step_fused(
                 self._ot_snd, u, flat, gc_seed, b2a_seed, count_field, garbler
             )
-            await _send(self._peer_writer, np.asarray(msg))
+            await _send(self._peer_writer, await _fetch(msg))
         else:  # evaluator + OT receiver (inputs stay on device: each
             # np.asarray here would cost a full tunnel round trip)
             u, t_rows, idx0 = secure.ev_step1_fused(self._ot_rcv, flat)
-            await _send(self._peer_writer, np.asarray(u))
+            await _send(self._peer_writer, await _fetch(u))
             bmsg = await _recv(self._peer_reader)
             vals = secure.ev_open_fused(
                 self._ot_rcv, t_rows, bmsg, B, S, count_field, idx0
@@ -465,7 +475,7 @@ class CollectorServer:
         t2 = time.perf_counter()
         vals = vals.reshape((F_, C, N) + count_field.limb_shape)
         shares = secure.node_share_sums(count_field, vals, jnp.asarray(w))
-        shares = np.asarray(shares)
+        shares = await _fetch(shares)
         t3 = time.perf_counter()
         for i, dt in enumerate((t1 - t0, t2 - t1, t3 - t2)):
             self._phase_seconds[i] += dt
