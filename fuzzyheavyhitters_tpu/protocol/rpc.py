@@ -499,7 +499,9 @@ class CollectorServer:
         # server.rs:331-332).  Secrecy comes from secure_exchange above.
         r = mask_fe62(level, counts.size).reshape(counts.shape)
         if self.server_id == 0:
-            return np.asarray(FE62.add(counts.astype(np.uint64), r))
+            # FE62.add is a jnp op: fetch off-loop like every other
+            # device->host transfer in the data plane (see _fetch)
+            return await _fetch(FE62.add(counts.astype(np.uint64), r))
         return r
 
     async def tree_crawl_last(self, req) -> np.ndarray:
@@ -517,7 +519,7 @@ class CollectorServer:
             if self.server_id == 0:
                 c = np.zeros(counts.shape + (8,), np.uint32)
                 c[..., 0] = counts
-                shares = np.asarray(F255.add(c, r))
+                shares = await _fetch(F255.add(c, r))
             else:
                 shares = r
         self._last_shares = shares
