@@ -690,7 +690,7 @@ def bench_secure_device(n=65536, L=64, f_bucket=4, with_l512=True):
     k0, k1, f0, f1 = make_keys(L)
     alive_keys = jnp.ones(n, bool)
 
-    def level_fn(field, fb=f_bucket):
+    def level_fn(field, fb=f_bucket, eq_ot4=None):
         limb = field.limb_shape
         W = secure.payload_words(field)
         B = fb * C * n
@@ -698,6 +698,8 @@ def bench_secure_device(n=65536, L=64, f_bucket=4, with_l512=True):
         w = jnp.asarray(
             secure.alive_weight(np.ones(fb, bool), np.ones(n, bool), C)
         )
+        if eq_ot4 is None:
+            eq_ot4 = secure._ot4_use(S)
 
         @jax.jit
         def run(keys0, fr0, keys1, fr1, lvl):
@@ -711,17 +713,26 @@ def bench_secure_device(n=65536, L=64, f_bucket=4, with_l512=True):
             )
             q = otext._sender_extend(sm_snd, s_bits_d, u, off, m)
             s_block = otext.pack_bits(s_bits_d)
-            # fused output-label b2a (the socket flow's math, sans IO)
             r_words = prgmod.stream_words(bseed, B * W).reshape(B, W)
             r0 = field.sample(r_words)
             r1 = field.add(r0, field.from_int(1))
             w0, w1 = secure.field_to_words(field, r0), secure.field_to_words(field, r1)
-            batch, cts, _mask = gc.garble_equality_payload(
-                s_block, q.reshape(B, S, 4), gseed, flat0, w1, w0, W, 0
-            )
-            _, pay = gc.eval_equality_payload(
-                batch, t_rows.reshape(B, S, 4), cts, W, 0
-            )
+            if eq_ot4:
+                # S = 2 fast path: 1-of-4 chosen-payload OT, no circuit
+                cts4 = secure.ot4_encrypt(
+                    q.reshape(B, S, 4), s_block, flat0, w1, w0, W, 0
+                )
+                pay = secure.ot4_decrypt(
+                    t_rows.reshape(B, S, 4), flat1, cts4, W, 0
+                )
+            else:
+                # GC + fused output-label b2a (the parity path's math)
+                batch, cts, _mask = gc.garble_equality_payload(
+                    s_block, q.reshape(B, S, 4), gseed, flat0, w1, w0, W, 0
+                )
+                _, pay = gc.eval_equality_payload(
+                    batch, t_rows.reshape(B, S, 4), cts, W, 0
+                )
             v1 = secure.words_to_field(field, pay)
             sh0 = secure.node_share_sums(
                 field, r1.reshape((fb, C, n) + limb), w
@@ -746,21 +757,29 @@ def bench_secure_device(n=65536, L=64, f_bucket=4, with_l512=True):
             iters=iters,
         )
 
-    # engine A/B on the hot pair (gc.GC_PALLAS): XLA first, the fused
-    # Pallas default LAST so the headline numbers come from the default
-    # engine's run (the crawl bench's convention — only back-to-back
-    # comparisons mean anything on the shared chip)
+    # engine A/B, non-default engines first and the default LAST so the
+    # headline numbers come from the default engine's run (the crawl
+    # bench's convention — only back-to-back comparisons mean anything on
+    # the shared chip): the GC+fused-b2a path (the reference-parity
+    # protocol shape, S-general) vs the S = 2 1-of-4-OT fast path
+    # (secure.EQ_OT4, the production default for 1-dim crawls)
     from fuzzyheavyhitters_tpu.ops import gc as gcmod
 
     best_xla_gc = None
-    if gcmod._pallas_engine():
+    best_gc_path = None
+    if gcmod._pallas_engine():  # GC path on the XLA gc engine
         gcmod.GC_PALLAS = False
         try:
-            run_x = level_fn(FE62)
+            run_x = level_fn(FE62, eq_ot4=False)
             run_x(k0, f0, k1, f1, 0)  # warm/compile
             best_xla_gc = _lvl_seconds(run_x, k0, f0, k1, f1, 0)
         finally:
             gcmod.GC_PALLAS = True
+    if secure._ot4_use(S):  # GC path on its default engine (the ot4
+        # headline's comparison point; identical to the headline otherwise)
+        run_g = level_fn(FE62, eq_ot4=False)
+        run_g(k0, f0, k1, f1, 0)  # warm/compile
+        best_gc_path = _lvl_seconds(run_g, k0, f0, k1, f1, 0)
 
     results = {}
     for name, field in (("fe62", FE62), ("f255", F255)):
@@ -778,13 +797,25 @@ def bench_secure_device(n=65536, L=64, f_bucket=4, with_l512=True):
         assert np.array_equal(counts.astype(np.uint64), want.astype(np.uint64))
         results[name] = _lvl_seconds(run, k0, f0, k1, f1, 0)
     out_extra = {}
+    if best_gc_path is not None:
+        out_extra["secure_device_ms_per_level_fe62_gc_path"] = round(
+            best_gc_path * 1000, 3
+        )
+        out_extra["ot4_speedup_vs_gc_path"] = round(
+            best_gc_path / results["fe62"], 2
+        )
     if best_xla_gc is not None:
         out_extra["secure_device_ms_per_level_fe62_xla_gc"] = round(
             best_xla_gc * 1000, 3
         )
-        out_extra["gc_engine_speedup_vs_xla"] = round(
-            best_xla_gc / results["fe62"], 2
-        )
+        if best_gc_path is not None:
+            out_extra["gc_engine_speedup_vs_xla"] = round(
+                best_xla_gc / best_gc_path, 2
+            )
+        else:
+            out_extra["gc_engine_speedup_vs_xla"] = round(
+                best_xla_gc / results["fe62"], 2
+            )
     if with_l512:
         k0b, k1b, f0b, f1b = make_keys(512)
         run = level_fn(FE62)
@@ -837,8 +868,12 @@ def bench_secure_device(n=65536, L=64, f_bucket=4, with_l512=True):
     )
 
     total = results["fe62"] * (L - 1) + results["f255"]
-    # garbled batch + payload ciphertexts resident per level (FE62 words)
-    gc_bytes = B * ((S - 1) * 2 * 16 + S * 16 + 4 + 2 * 4 * 4)
+    # data-plane batch resident per level (FE62 words): the 1-of-4 payload
+    # table on the fast path, garbled batch + payload ciphertexts on GC
+    if secure._ot4_use(S):
+        gc_bytes = B * 4 * 4 * 4  # cts uint32[4, B, W=4]
+    else:
+        gc_bytes = B * ((S - 1) * 2 * 16 + S * 16 + 4 + 2 * 4 * 4)
     return {
         "secure_device_clients_per_sec": round(n / total, 1),
         "secure_device_ms_per_level_fe62": round(results["fe62"] * 1000, 3),

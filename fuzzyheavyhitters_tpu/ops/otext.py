@@ -129,12 +129,16 @@ def _sender_extend(seeds, s_bits, u, offset, m):
     return _transpose_pack(q, m)
 
 
-@partial(jax.jit, static_argnames=("n_words",))
-def ot_hash(rows: jax.Array, n_words: int, idx_offset=0) -> jax.Array:
+@partial(jax.jit, static_argnames=("n_words", "domain"))
+def ot_hash(rows: jax.Array, n_words: int, idx_offset=0,
+            domain: int = 0) -> jax.Array:
     """Correlation-robust hash of 128-bit rows -> uint32[..., n_words] pads.
 
     The per-row OT index is folded into the tweak so identical rows at
     different positions hash independently (the `H(j, ·)` of IKNP).
+    ``domain`` separates distinct protocol uses that might share an index
+    range (e.g. the 1-of-4 per-TEST pads vs per-ROW Δ-OT pads of the same
+    extension batch); it XORs into tweak word 1.
     """
     rows = jnp.asarray(rows, jnp.uint32)
     m = rows.shape[-2]
@@ -143,7 +147,7 @@ def ot_hash(rows: jax.Array, n_words: int, idx_offset=0) -> jax.Array:
     tweak = jnp.stack(
         [
             jnp.broadcast_to(idx, shape),
-            jnp.full(shape, _OT_TWEAK1, jnp.uint32),
+            jnp.full(shape, _OT_TWEAK1 ^ domain, jnp.uint32),
             jnp.full(shape, _OT_TWEAK2, jnp.uint32),
             jnp.full(shape, _OT_TWEAK3, jnp.uint32),
         ],
@@ -151,6 +155,25 @@ def ot_hash(rows: jax.Array, n_words: int, idx_offset=0) -> jax.Array:
     )
     # fusion fence before slicing (see prg._expand_jit's rationale)
     return jax.lax.optimization_barrier(prg.chacha_block(rows ^ tweak))[..., :n_words]
+
+
+def gf128_double(x: jax.Array) -> jax.Array:
+    """Multiply 128-bit blocks by x in GF(2^128) (poly x^128+x^7+x^2+x+1).
+
+    Blocks are uint32[..., 4] little-endian (bit 0 = lsb of word 0 — the
+    :func:`pack_bits` orientation).  One shift-with-carry across the four
+    words plus a conditional XOR of the reduction constant 0x87.  Used to
+    combine two Δ-OT rows into one hash input with distinct coefficients
+    (the 1-of-4 chosen-payload OT of protocol/secure.py): the four sender
+    offsets {0, s, 2s, 3s} are pairwise distinct for any s != 0 because
+    doubling is an invertible linear map.
+    """
+    x = jnp.asarray(x, jnp.uint32)
+    hi = x[..., 3] >> 31  # the outgoing x^127 bit
+    shifted = (x << 1) | jnp.concatenate(
+        [jnp.zeros_like(x[..., :1]), x[..., :3] >> 31], axis=-1
+    )
+    return shifted.at[..., 0].set(shifted[..., 0] ^ hi * jnp.uint32(0x87))
 
 
 def s_to_block(s_bits: np.ndarray) -> np.ndarray:

@@ -359,7 +359,8 @@ class MeshRunner:
         secrets between shards (u_A ^ u_B = r_A ^ r_B, and identical X0
         labels reveal x_A ^ x_B), so every seed is tweaked by the shard
         index inside the body — consistently on both parties."""
-        key = ("secure", field.__name__, garbler, want_children)
+        key = ("secure", field.__name__, garbler, want_children,
+               secure._ot4_use(2 * self.n_dims))
         if key not in self._kernel_cache:
             self._kernel_cache[key] = self._make_secure_body(
                 field, garbler, want_children
@@ -404,29 +405,44 @@ class MeshRunner:
             u0 = jax.lax.ppermute(u, SERVERS, perm=[(ev, g)])
             q = otext._sender_extend(sm, s_bits_l, u0, off, m)
             s_block = otext.pack_bits(s_bits_l)
-            batch, mask = gc.garble_equality_delta(
-                s_block, q.reshape(B, S, 4), gseed, flat
-            )
-            ev_batch = gc.GarbledEqBatch(
-                tables=jax.lax.ppermute(batch.tables, SERVERS, perm=[(g, ev)]),
-                gb_labels=jax.lax.ppermute(batch.gb_labels, SERVERS, perm=[(g, ev)]),
-                decode=jax.lax.ppermute(batch.decode, SERVERS, perm=[(g, ev)]),
-            )
-            e = gc.eval_equality(ev_batch, t_rows.reshape(B, S, 4))
+            if secure._ot4_use(S):
+                # 1-of-4 chosen-payload OT: no circuit, the payload table
+                # IS the message — 2 ppermutes per level (u, cts) instead
+                # of the GC path's 7 (see secure.py's S = 2 fast path)
+                W = secure.payload_words(field)
+                r1, w0, w1 = secure.b2a_payload_pair(field, bseed, B, g)
+                cts_g = secure.ot4_encrypt(
+                    q.reshape(B, S, 4), s_block, flat, w1, w0, W, sent
+                )
+                cts = jax.lax.ppermute(cts_g, SERVERS, perm=[(g, ev)])
+                w_pay = secure.ot4_decrypt(
+                    t_rows.reshape(B, S, 4), flat, cts, W, sent
+                )
+                v1 = secure.words_to_field(field, w_pay)
+            else:
+                batch, mask = gc.garble_equality_delta(
+                    s_block, q.reshape(B, S, 4), gseed, flat
+                )
+                ev_batch = gc.GarbledEqBatch(
+                    tables=jax.lax.ppermute(batch.tables, SERVERS, perm=[(g, ev)]),
+                    gb_labels=jax.lax.ppermute(batch.gb_labels, SERVERS, perm=[(g, ev)]),
+                    decode=jax.lax.ppermute(batch.decode, SERVERS, perm=[(g, ev)]),
+                )
+                e = gc.eval_equality(ev_batch, t_rows.reshape(B, S, 4))
 
-            # b2a conversion (r1 - r0 = 1 trick) under chosen-payload pads
-            w_cols = -(-m // 32)
-            off2 = off + (-(-w_cols // 16))
-            u2, t2_rows = otext._receiver_extend(sm, sa, e, off2, B)
-            u2_0 = jax.lax.ppermute(u2, SERVERS, perm=[(ev, g)])
-            q2 = otext._sender_extend(sm, s_bits_l, u2_0, off2, B)
-            idx0 = sent + m
-            c0g, c1g, r1 = secure.b2a_encrypt(
-                field, q2, s_block, mask, bseed, idx0, g
-            )
-            c0 = jax.lax.ppermute(c0g, SERVERS, perm=[(g, ev)])
-            c1 = jax.lax.ppermute(c1g, SERVERS, perm=[(g, ev)])
-            v1 = secure.b2a_decrypt(field, t2_rows, idx0, c0, c1, e)
+                # b2a conversion (r1 - r0 = 1 trick) under chosen-payload pads
+                w_cols = -(-m // 32)
+                off2 = off + (-(-w_cols // 16))
+                u2, t2_rows = otext._receiver_extend(sm, sa, e, off2, B)
+                u2_0 = jax.lax.ppermute(u2, SERVERS, perm=[(ev, g)])
+                q2 = otext._sender_extend(sm, s_bits_l, u2_0, off2, B)
+                idx0 = sent + m
+                c0g, c1g, r1 = secure.b2a_encrypt(
+                    field, q2, s_block, mask, bseed, idx0, g
+                )
+                c0 = jax.lax.ppermute(c0g, SERVERS, perm=[(g, ev)])
+                c1 = jax.lax.ppermute(c1g, SERVERS, perm=[(g, ev)])
+                v1 = secure.b2a_decrypt(field, t2_rows, idx0, c0, c1, e)
 
             party = jax.lax.axis_index(SERVERS)
             vals = jnp.where(party == g, r1, v1)  # own additive share per test
@@ -530,9 +546,15 @@ class MeshRunner:
         else:
             shares, self._children = out
         w1 = -(-m // 32)
-        w2 = -(-B // 32)
-        sess["blocks"] += (-(-w1 // 16)) + (-(-w2 // 16))
-        sess["sent"] += m + B
+        if secure._ot4_use(2 * self.n_dims):
+            # S = 2 fast path: one extension (m rows), per-test pads in
+            # their own tweak domain — no second b2a extension
+            sess["blocks"] += -(-w1 // 16)
+            sess["sent"] += m
+        else:
+            w2 = -(-B // 32)
+            sess["blocks"] += (-(-w1 // 16)) + (-(-w2 // 16))
+            sess["sent"] += m + B
         return np.asarray(shares)
 
     def advance(self, level: int, parent_idx, pattern_bits, n_alive: int):

@@ -458,20 +458,32 @@ class CollectorServer:
         self._crawl_ctr += 1
         gc_seed = secure.derive_seed(self._sec_seed, 1, level, self._crawl_ctr)
         b2a_seed = secure.derive_seed(self._sec_seed, 2, level, self._crawl_ctr)
-        if self.server_id == garbler:  # garbler + OT-extension sender
+        ot4 = secure._ot4_use(S)  # S == 2: 1-of-4 OT, no garbled circuit
+        if self.server_id == garbler:  # garbler/sender + OT-extension sender
             u = await _recv(self._peer_reader)
-            msg, vals = secure.gb_step_fused(
-                self._ot_snd, u, flat, gc_seed, b2a_seed, count_field, garbler
-            )
+            if ot4:
+                msg, vals = secure.gb_step_ot4(
+                    self._ot_snd, u, flat, b2a_seed, count_field, garbler
+                )
+            else:
+                msg, vals = secure.gb_step_fused(
+                    self._ot_snd, u, flat, gc_seed, b2a_seed, count_field,
+                    garbler,
+                )
             await _send(self._peer_writer, await _fetch(msg))
         else:  # evaluator + OT receiver (inputs stay on device: each
             # np.asarray here would cost a full tunnel round trip)
             u, t_rows, idx0 = secure.ev_step1_fused(self._ot_rcv, flat)
             await _send(self._peer_writer, await _fetch(u))
             bmsg = await _recv(self._peer_reader)
-            vals = secure.ev_open_fused(
-                self._ot_rcv, t_rows, bmsg, B, S, count_field, idx0
-            )
+            if ot4:
+                vals = secure.ev_open_ot4(
+                    self._ot_rcv, t_rows, flat, bmsg, B, count_field, idx0
+                )
+            else:
+                vals = secure.ev_open_fused(
+                    self._ot_rcv, t_rows, bmsg, B, S, count_field, idx0
+                )
         t2 = time.perf_counter()
         vals = vals.reshape((F_, C, N) + count_field.limb_shape)
         shares = secure.node_share_sums(count_field, vals, jnp.asarray(w))

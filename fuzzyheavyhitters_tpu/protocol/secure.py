@@ -151,26 +151,36 @@ def ev_step3(rcv: otext.OtExtReceiver, e_bits):
     return u2, t2, idx0
 
 
+def b2a_payload_pair(field, b2a_seed, B: int, garbler: int):
+    """The sender's b2a share pair, in ONE place for every flow: sample
+    ``r0`` from the seed stream, keep ``r1 = r0 ± 1`` — +1 when server 0
+    is the sender, −1 when server 1 is — so the leader's uniform
+    ``v0 - v1`` reconstruction holds whichever server sends
+    (collect.rs:439-456's ordering with the alternating-garbler sign).
+    Returns (r1 — the sender's additive shares, w0, w1 — the two payloads
+    as OT words)."""
+    W = payload_words(field)
+    r_words = prg.stream_words(jnp.asarray(b2a_seed, jnp.uint32), B * W).reshape(B, W)
+    r0 = field.sample(r_words)
+    one = field.from_int(1)
+    r1 = field.sub(r0, one) if garbler else field.add(r0, one)
+    return r1, field_to_words(field, r0), field_to_words(field, r1)
+
+
 def b2a_encrypt(field, q2_rows, s_block, mask, b2a_seed, idx0, garbler: int = 0):
     """Stateless b2a sender core: sample (r0, r1 = r0 ± 1), order payloads
     by ``mask`` (collect.rs:439-456), encrypt under the OT pads derived
     from the Q rows.  Returns (c0, c1 ciphertext words [B, W], r1 — the
-    sender's additive shares).  ``garbler`` fixes the share SIGN so the
-    leader's uniform ``v0 - v1`` reconstruction holds whichever server
-    garbles: server 0 keeps ``r0 + 1``, server 1 keeps ``r0 - 1``.
-    Shared by the socket path (gb_step2) and the mesh kernel
-    (parallel/mesh.py) so the trick lives in exactly one place."""
+    sender's additive shares).  Shared by the socket path (gb_step2) and
+    the mesh kernel (parallel/mesh.py) so the trick lives in exactly one
+    place."""
     mask = jnp.asarray(mask, bool)
     B = mask.shape[0]
     W = payload_words(field)
     q2_rows = jnp.asarray(q2_rows)
     pad0 = otext.ot_hash(q2_rows, W, idx0)
     pad1 = otext.ot_hash(q2_rows ^ jnp.asarray(s_block), W, idx0)
-    r_words = prg.stream_words(jnp.asarray(b2a_seed, jnp.uint32), B * W).reshape(B, W)
-    r0 = field.sample(r_words)
-    one = field.from_int(1)
-    r1 = field.sub(r0, one) if garbler else field.add(r0, one)
-    w0, w1 = field_to_words(field, r0), field_to_words(field, r1)
+    r1, w0, w1 = b2a_payload_pair(field, b2a_seed, B, garbler)
     m0 = jnp.where(mask[:, None], w0, w1)
     m1 = jnp.where(mask[:, None], w1, w0)
     return m0 ^ pad0, m1 ^ pad1, r1
@@ -201,6 +211,116 @@ def ev_step4(rcv: otext.OtExtReceiver, t2_rows, idx0, c0, c1, e_bits, field):
     """Evaluator: decrypt its chosen payload -> field values [B] (its
     additive shares: r0 where equal, r1 where not)."""
     return b2a_decrypt(field, t2_rows, idx0, c0, c1, e_bits)
+
+
+# ---------------------------------------------------------------------------
+# S = 2 fast path: equality via 1-of-4 chosen-payload OT (no garbled circuit)
+# ---------------------------------------------------------------------------
+#
+# For one-dimensional crawls (the flagship zipf/rides shape) each equality
+# test compares S = 2 bits — the two interval sides of the single dim.  The
+# full GC machinery (1 AND gate, 4 garble + 2 eval hashes, tables + labels
+# + decode on the wire) exists to compute [x == y] for 2-bit x, y.  But a
+# 2-bit y is a 1-of-4 choice, and the test's two Δ-OT rows (t_j = q_j ^
+# y_j·s) already encode it: combining the rows with distinct GF(2^128)
+# coefficients, T = t_0 ^ 2·t_1 = Q ^ (y_0·s ^ y_1·2s) where Q = q_0 ^
+# 2·q_1, gives the receiver exactly ONE of the four sender-computable pads
+# H(Q ^ o_c), o_c = c_0·s ^ c_1·2s, c in {0,1}² — pairwise distinct
+# offsets since doubling is invertible and s != 0.  The sender encrypts
+# payload m_{[x == c]} under pad c; the receiver opens pad y and learns
+# m_{[x == y]} — the whole equality test + payload b2a in 5 hashes/test
+# (4 garbler + 1 evaluator) instead of the GC path's 9, with ~40% of its
+# wire bytes (4 ciphertexts vs tables + labels + decode + 2 ciphertexts).
+# This is the classic 1-of-N OT-extension pad construction (Kolesnikov-
+# Kumaresan 2013 shape) under the same circular-correlation-robust-hash
+# assumption the Δ-OT pads and the GC fused payload already rest on.
+#
+# The GC path (ops/gc.py) remains for S > 2 (multi-dim tests need the
+# AND-tree) and as the reference-parity mode; ``EQ_OT4`` selects the fast
+# path for S == 2 everywhere (it is pure protocol math — no Pallas — so it
+# runs identically on CPU test hosts and chips; both modes stay tested).
+
+EQ_OT4: bool = True
+
+_OT4_DOMAIN = 0x0F4E4F54  # ot_hash tweak-domain of the per-test 1-of-4 pads
+
+
+def _ot4_use(S: int) -> bool:
+    return EQ_OT4 and S == 2
+
+
+@partial(jax.jit, static_argnames=("n_words",))
+def ot4_encrypt(q_rows, s_block, x_flat, m_v0, m_v1, n_words: int, idx_offset):
+    """Sender side: q_rows uint32[B, 2, 4] (this batch's extension rows),
+    x_flat bool[B, 2] (the sender's share-bit strings), payloads
+    m_v0/m_v1 uint32[B, n_words] for result 0 / 1.  Returns cts
+    uint32[4, B, n_words] indexed by the receiver's string as a little-
+    endian 2-bit integer c = y_0 + 2·y_1."""
+    q_rows = jnp.asarray(q_rows, jnp.uint32)
+    x_flat = jnp.asarray(x_flat, bool)
+    s = jnp.asarray(s_block, jnp.uint32)
+    comb = q_rows[:, 0] ^ otext.gf128_double(q_rows[:, 1])  # [B, 4]
+    s2 = otext.gf128_double(s)
+    x_int = x_flat[:, 0].astype(jnp.uint32) + 2 * x_flat[:, 1].astype(jnp.uint32)
+    offs = jnp.stack([
+        jnp.zeros_like(s), s, s2, s ^ s2
+    ])  # [4, 4] — offset of choice c = c0·s ^ c1·2s
+    pads = otext.ot_hash(
+        comb[None] ^ offs[:, None, :], n_words, idx_offset, domain=_OT4_DOMAIN
+    )  # [4, B, n_words]
+    eq = jnp.arange(4, dtype=jnp.uint32)[:, None] == x_int[None]  # [4, B]
+    m = jnp.where(
+        eq[..., None], jnp.asarray(m_v1, jnp.uint32)[None],
+        jnp.asarray(m_v0, jnp.uint32)[None],
+    )
+    return m ^ pads
+
+
+@partial(jax.jit, static_argnames=("n_words",))
+def ot4_decrypt(t_rows, y_flat, cts, n_words: int, idx_offset):
+    """Receiver side: t_rows uint32[B, 2, 4], y_flat bool[B, 2] (its own
+    share-bit strings — the extension's choice bits), cts uint32[4, B,
+    n_words].  Returns uint32[B, n_words] = m_{[x == y]} per test."""
+    t_rows = jnp.asarray(t_rows, jnp.uint32)
+    y_flat = jnp.asarray(y_flat, bool)
+    comb = t_rows[:, 0] ^ otext.gf128_double(t_rows[:, 1])  # [B, 4]
+    pad = otext.ot_hash(comb, n_words, idx_offset, domain=_OT4_DOMAIN)
+    y_int = y_flat[:, 0].astype(jnp.uint32) + 2 * y_flat[:, 1].astype(jnp.uint32)
+    ct = jnp.take_along_axis(
+        jnp.asarray(cts, jnp.uint32), y_int[None, :, None], axis=0
+    )[0]
+    return ct ^ pad
+
+
+def gb_step_ot4(snd: otext.OtExtSender, u_msg, x_flat, b2a_seed, field,
+                garbler: int = 0):
+    """Garbler/sender level step on the S = 2 fast path: extend the Δ-OT,
+    derive (r0, r1 = r0 ± 1), and encrypt the 1-of-4 payload table — the
+    whole level in one message (cts ravel), like :func:`gb_step_fused`.
+
+    Returns (cts uint32[4, B, W], vals — the sender's additive shares)."""
+    x_flat = jnp.asarray(x_flat, bool)
+    B, S = x_flat.shape
+    assert S == 2, "ot4 path is the S == 2 specialization"
+    idx0 = snd.consumed
+    q = snd.extend(B * S, u_msg)
+    W = payload_words(field)
+    r1, w0, w1 = b2a_payload_pair(field, b2a_seed, B, garbler)
+    # result 1 (strings equal) -> receiver learns r0 (collect.rs:439-456)
+    cts = ot4_encrypt(
+        q.reshape(B, S, 4), jnp.asarray(snd.s_block), x_flat, w1, w0, W, idx0
+    )
+    return cts, r1
+
+
+def ev_open_ot4(rcv: otext.OtExtReceiver, t_rows, y_flat, msg, B: int,
+                field, idx0: int):
+    """Receiver twin of :func:`gb_step_ot4`: open the 1-of-4 table with the
+    combined T rows -> field values [B] (r0 where equal, else r1)."""
+    W = payload_words(field)
+    cts = jnp.asarray(msg).reshape(4, B, W)
+    w = ot4_decrypt(jnp.asarray(t_rows).reshape(B, 2, 4), y_flat, cts, W, idx0)
+    return words_to_field(field, w)
 
 
 # ---------------------------------------------------------------------------
@@ -243,11 +363,7 @@ def gb_step_fused(snd: otext.OtExtSender, u_msg, x_flat, gc_seed, b2a_seed,
     idx0 = snd.consumed
     q = snd.extend(B * S, u_msg)
     W = payload_words(field)
-    r_words = prg.stream_words(jnp.asarray(b2a_seed, jnp.uint32), B * W).reshape(B, W)
-    r0 = field.sample(r_words)
-    one = field.from_int(1)
-    r1 = field.sub(r0, one) if garbler else field.add(r0, one)
-    w0, w1 = field_to_words(field, r0), field_to_words(field, r1)
+    r1, w0, w1 = b2a_payload_pair(field, b2a_seed, B, garbler)
     # v = 1 (strings equal) -> evaluator learns r0, else r1: the ordering
     # of collect.rs:439-456 with the choice implicit in the output label
     batch, cts, _ = gc.garble_equality_payload(

@@ -100,6 +100,38 @@ def test_mesh_secure_matches_trusted(client_batch, colocated_result, cpu_devices
     assert not np.array_equal(sh_a, sh_b)
 
 
+def test_mesh_secure_ot4_matches_trusted(cpu_devices):
+    """n_dims = 1 -> S = 2: the mesh secure body takes the 1-of-4
+    chosen-payload-OT fast path (2 ppermutes per level, no garbled
+    circuit; secure.EQ_OT4) and must still reconstruct the exact
+    trusted-mode heavy hitters, with the garbler alternating per level."""
+    rng = np.random.default_rng(11)
+    L, d, n = 5, 1, 32
+    centers = rng.integers(0, 1 << L, size=(3, d))
+    pts = np.clip(
+        centers[rng.integers(0, 3, size=n)] + rng.integers(-1, 2, size=(n, d)),
+        0, (1 << L) - 1,
+    )
+    pts_bits = np.array(
+        [[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts]
+    )
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 1, rng, engine="np")
+
+    with jax.default_device(cpu_devices[0]):
+        s0, s1 = driver.make_servers(k0, k1)
+        lead = driver.Leader(s0, s1, n_dims=d, data_len=L, f_max=64)
+        want = _as_dict(lead.run(nreqs=n, threshold=0.1))
+    assert want
+
+    from fuzzyheavyhitters_tpu.protocol import secure
+
+    assert secure._ot4_use(2 * d)  # the default engine for 1-dim crawls
+    m = meshmod.make_mesh(devices=cpu_devices)
+    runner = meshmod.MeshRunner(m, k0, k1, f_max=64, secure_exchange=True)
+    got = _as_dict(meshmod.MeshLeader(runner).run(nreqs=n, threshold=0.1))
+    assert got == want
+
+
 def test_odd_device_count_rejected(cpu_devices):
     with pytest.raises(AssertionError, match="even"):
         meshmod.make_mesh(devices=cpu_devices[:3])

@@ -92,6 +92,86 @@ def test_pipeline_fused_sans_io(ot_pair, rng, field, garbler):
         np.testing.assert_array_equal(diff, want)
 
 
+def test_gf128_double_linearity_and_carry():
+    """gf128_double: shift-with-carry semantics and linearity over XOR —
+    the properties the 1-of-4 pad-offset distinctness proof rests on."""
+    rng = np.random.default_rng(7)
+    x = rng.integers(0, 2**32, size=(8, 4), dtype=np.uint32)
+    y = rng.integers(0, 2**32, size=(8, 4), dtype=np.uint32)
+    dbl = lambda a: np.asarray(otext.gf128_double(a))
+    # linearity: 2(x ^ y) == 2x ^ 2y
+    np.testing.assert_array_equal(dbl(x ^ y), dbl(x) ^ dbl(y))
+    # no-carry case: plain 128-bit left shift
+    lo = np.array([[0x40000000, 1, 0x80000000, 0x3FFFFFFF]], np.uint32)
+    np.testing.assert_array_equal(
+        dbl(lo), [[0x80000000, 2, 0, 0x7FFFFFFF]]
+    )
+    # carry case: x^127 wraps to the reduction constant 0x87
+    hi = np.zeros((1, 4), np.uint32)
+    hi[0, 3] = 0x80000000
+    np.testing.assert_array_equal(dbl(hi), [[0x87, 0, 0, 0]])
+    # doubling is invertible (linear + injective on a sample)
+    assert len({bytes(r) for r in dbl(x)}) == len(x)
+    # {0, s, 2s, 3s} pairwise distinct for s != 0 — the 4 pad offsets
+    s = rng.integers(1, 2**32, size=(1, 4), dtype=np.uint32)
+    offs = [np.zeros((1, 4), np.uint32), s, dbl(s), s ^ dbl(s)]
+    assert len({bytes(o[0]) for o in offs}) == 4
+
+
+@pytest.mark.parametrize("field", [FE62, F255], ids=["FE62", "F255"])
+@pytest.mark.parametrize("garbler", [0, 1])
+def test_pipeline_ot4_sans_io(ot_pair, rng, field, garbler):
+    """The S = 2 fast path (1-of-4 chosen-payload OT, secure.gb_step_ot4 /
+    ev_open_ot4): v0 - v1 == [x == y] per test on both garbling sides —
+    the same contract as the GC fused flow it replaces for 1-dim crawls."""
+    snd, rcv = ot_pair
+    B, S = 64, 2
+    x = rng.integers(0, 2, size=(B, S)).astype(bool)
+    y = x.copy()
+    flip = rng.integers(0, 2, size=B).astype(bool)
+    y[flip, rng.integers(0, S, size=B)[flip]] ^= True
+    eq = np.all(x == y, axis=1)
+
+    b2a_seed = np.frombuffer(pysecrets.token_bytes(16), "<u4")
+    u, t_rows, idx0 = secure.ev_step1_fused(rcv, y)
+    msg, v_snd = secure.gb_step_ot4(
+        snd, np.asarray(u), x, b2a_seed, field, garbler
+    )
+    v_rcv = secure.ev_open_ot4(
+        rcv, t_rows, y, np.asarray(msg), B, field, idx0
+    )
+    v0, v1 = (v_snd, v_rcv) if garbler == 0 else (v_rcv, v_snd)
+    diff = np.asarray(field.canon(field.sub(v0, v1)))
+    want = eq.astype(np.uint64)
+    if field is F255:
+        np.testing.assert_array_equal(diff[:, 0], want.astype(np.uint32))
+        assert not diff[:, 1:].any()
+    else:
+        np.testing.assert_array_equal(diff, want)
+
+
+def test_ot4_receiver_learns_exactly_one_payload(ot_pair, rng):
+    """1-of-4 privacy shape: decrypting with a WRONG choice (a string the
+    receiver does not hold rows for) yields pad-garbage, not a payload —
+    i.e. the table holds exactly one opening per receiver."""
+    snd, rcv = ot_pair
+    B = 32
+    x = rng.integers(0, 2, size=(B, 2)).astype(bool)
+    y = rng.integers(0, 2, size=(B, 2)).astype(bool)
+    b2a_seed = np.frombuffer(pysecrets.token_bytes(16), "<u4")
+    u, t_rows, idx0 = secure.ev_step1_fused(rcv, y)
+    msg, _ = secure.gb_step_ot4(snd, np.asarray(u), x, b2a_seed, FE62, 0)
+    good = np.asarray(FE62.canon(
+        secure.ev_open_ot4(rcv, t_rows, y, np.asarray(msg), B, FE62, idx0)
+    ))
+    bad = np.asarray(FE62.canon(
+        secure.ev_open_ot4(rcv, t_rows, ~y, np.asarray(msg), B, FE62, idx0)
+    ))
+    # wrong-choice openings decrypt the wrong row with the wrong pad:
+    # they must not reproduce the correct payloads (w.h.p.)
+    assert (good != bad).sum() >= B - 1
+
+
 def test_evaluator_share_is_masked(ot_pair, rng):
     """The evaluator's GC output alone must not reveal equality: its share
     differs from the plaintext wherever the garbler's mask bit is set."""
@@ -191,8 +271,13 @@ def _client_keys(rng, L, n):
     return ibdcf.gen_l_inf_ball(pts_bits, 1, rng, engine="np")
 
 
-def test_secure_socket_run_matches_trusted(rng, monkeypatch):
+@pytest.mark.parametrize("eq_ot4", [True, False], ids=["ot4", "gc"])
+def test_secure_socket_run_matches_trusted(rng, monkeypatch, eq_ot4):
+    """n_dims = 1 -> S = 2: runs the 1-of-4 fast path (the production
+    default) AND the GC parity path through the full socket flow."""
+    monkeypatch.setattr(secure, "EQ_OT4", eq_ot4)
     L, n = 5, 12
+    port_base = BASE_PORT + (0 if eq_ot4 else 40)  # distinct ports per run
     k0, k1 = _client_keys(rng, L, n)
 
     # record every data/control-plane payload and every packed tensor
@@ -212,7 +297,7 @@ def test_secure_socket_run_matches_trusted(rng, monkeypatch):
     monkeypatch.setattr(rpc, "_send", spy_send)
     monkeypatch.setattr(collect, "expand_share_bits", spy_expand)
 
-    cfg = _cfg(secure_exchange=True)
+    cfg = _cfg(port_base=port_base, secure_exchange=True)
     res = asyncio.run(_run_protocol(cfg, k0, k1, n))
     got = {
         tuple(int(v) for v in r): int(c)
