@@ -562,14 +562,15 @@ async def _bring_up_pair(cfg, port):
 
 def bench_secure(n=1024, L=12, port=39831):
     """Secure-mode aggregate crawl: both collector servers in one process
-    with the REAL GC+OT data plane (secure_exchange=true), full level loop
+    with the REAL 2PC data plane (secure_exchange=true), full level loop
     over localhost sockets on the default device.  End-to-end wall time.
-    The fused output-label b2a (secure.gb_step_fused) makes a level ONE
-    protocol round trip — ev u -> gb batch+cts — so the tunnel floor is
-    ~3 serial device<->host fetches per level (u, batch, shares) at the
-    reported ``device_fetch_rtt_ms`` (~0.12 s); round 4's two-round flow
-    measured ~10.  Still a lower bound on what adjacent hardware
-    achieves; ``bench_secure_device`` is the adjacent-chip number.
+    A level is ONE protocol round trip — ev u -> sender table (the 1-of-4
+    chosen-payload-OT fast path at this 1-dim shape; the GC+fused-b2a
+    flow for S > 2) — so the tunnel floor is ~3 serial device<->host
+    fetches per level (u, table, shares) at the reported
+    ``device_fetch_rtt_ms`` (~0.1 s); round 4's two-round flow measured
+    ~10.  Still a lower bound on what adjacent hardware achieves;
+    ``bench_secure_device`` is the adjacent-chip number.
     Ref seam: collect.rs:419-482 inside tree_crawl."""
     import asyncio
     import contextlib
@@ -797,25 +798,6 @@ def bench_secure_device(n=65536, L=64, f_bucket=4, with_l512=True):
         assert np.array_equal(counts.astype(np.uint64), want.astype(np.uint64))
         results[name] = _lvl_seconds(run, k0, f0, k1, f1, 0)
     out_extra = {}
-    if best_gc_path is not None:
-        out_extra["secure_device_ms_per_level_fe62_gc_path"] = round(
-            best_gc_path * 1000, 3
-        )
-        out_extra["ot4_speedup_vs_gc_path"] = round(
-            best_gc_path / results["fe62"], 2
-        )
-    if best_xla_gc is not None:
-        out_extra["secure_device_ms_per_level_fe62_xla_gc"] = round(
-            best_xla_gc * 1000, 3
-        )
-        if best_gc_path is not None:
-            out_extra["gc_engine_speedup_vs_xla"] = round(
-                best_xla_gc / best_gc_path, 2
-            )
-        else:
-            out_extra["gc_engine_speedup_vs_xla"] = round(
-                best_xla_gc / results["fe62"], 2
-            )
     if with_l512:
         k0b, k1b, f0b, f1b = make_keys(512)
         run = level_fn(FE62)
@@ -839,10 +821,51 @@ def bench_secure_device(n=65536, L=64, f_bucket=4, with_l512=True):
 
     trusted_level(k0, f0, k1, f1, 0)
     best_trusted = _lvl_seconds(trusted_level, k0, f0, k1, f1, 0)
+    # Contention guard: the shared chip occasionally hits multi-minute
+    # windows where memory-heavy programs run ~15x slow (observed: the
+    # same secure level measuring 19 ms and 294 ms an hour apart while
+    # the small hash-margin garble held steady).  The design floor of
+    # secure/trusted is ~3x (GC path ~4x); a ratio far above it flags
+    # such a window, so wait it out once and re-measure every affected
+    # side, reporting that the retry happened — min-of-trials inside one
+    # window can't see this.  The speedup ratios are computed AFTER this
+    # guard so they always compare the numbers actually reported.
+    def _contended(x):
+        return x is not None and x / best_trusted > 8
+
+    if _contended(results["fe62"]) or _contended(best_gc_path):
+        time.sleep(75)
+        run_r = level_fn(FE62)
+        run_r(k0, f0, k1, f1, 0)
+        results["fe62"] = min(results["fe62"],
+                              _lvl_seconds(run_r, k0, f0, k1, f1, 0))
+        if best_gc_path is not None:
+            run_g2 = level_fn(FE62, eq_ot4=False)
+            run_g2(k0, f0, k1, f1, 0)
+            best_gc_path = min(best_gc_path,
+                               _lvl_seconds(run_g2, k0, f0, k1, f1, 0))
+        best_trusted = min(best_trusted,
+                           _lvl_seconds(trusted_level, k0, f0, k1, f1, 0))
+        out_extra["contention_retry"] = True
     out_extra["trusted_same_shape_ms_per_level"] = round(best_trusted * 1000, 3)
     out_extra["secure_over_trusted_ratio"] = round(
         results["fe62"] / best_trusted, 2
     )
+    if best_gc_path is not None:
+        out_extra["secure_device_ms_per_level_fe62_gc_path"] = round(
+            best_gc_path * 1000, 3
+        )
+        out_extra["ot4_speedup_vs_gc_path"] = round(
+            best_gc_path / results["fe62"], 2
+        )
+    if best_xla_gc is not None:
+        out_extra["secure_device_ms_per_level_fe62_xla_gc"] = round(
+            best_xla_gc * 1000, 3
+        )
+        out_extra["gc_engine_speedup_vs_xla"] = round(
+            best_xla_gc / (best_gc_path if best_gc_path is not None
+                           else results["fe62"]), 2
+        )
 
     # second point at DOUBLE the bucket (same keys/clients, 2x the 2PC
     # work): splits the per-launch dispatch overhead from the marginal
@@ -1075,7 +1098,8 @@ def main():
     secure_device = _subprocess_metric(
         "import json, bench;"
         "print(json.dumps(bench.bench_secure_device()))",
-        timeout_s=540,
+        # headroom for the contention-retry path (see bench_secure_device)
+        timeout_s=1500,
     )
     hbm = _subprocess_metric(
         "import json, bench;"

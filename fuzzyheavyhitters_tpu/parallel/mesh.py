@@ -345,12 +345,15 @@ class MeshRunner:
 
     def _secure_counts_fn(self, field, garbler: int = 0, want_children: bool = True):
         """Build (and cache) the one-program secure level crawl for a
-        (count field, garbler party) pair: the whole GC+OT 2PC — label
-        extension, garbling, evaluation, b2a, alive-gated share sums — as
-        a single shard_mapped program whose only inter-party traffic is
-        four ``ppermute`` transfers on the ``servers`` axis (u-matrix,
-        garbled batch, b2a u-matrix, ciphertexts): the ICI twin of
-        protocol/rpc.py's socket flow.  ``garbler`` is static per program
+        (count field, garbler party) pair: the whole per-level 2PC —
+        label extension, equality + b2a, alive-gated share sums — as a
+        single shard_mapped program whose only inter-party traffic is
+        ``ppermute`` transfers on the ``servers`` axis: the ICI twin of
+        protocol/rpc.py's socket flow.  1-dim crawls (S = 2) take the
+        1-of-4 chosen-payload-OT fast path — no garbled circuit, TWO
+        transfers per level (u-matrix, payload table); S > 2 runs the
+        GC+OT form with seven (u-matrix, tables/labels/decode, b2a
+        u-matrix, ciphertext pair).  ``garbler`` is static per program
         (the perms are trace-time), two compiles per field.
 
         Per-data-shard uniqueness: every (0,j)<->(1,j) chip pair runs its

@@ -430,13 +430,15 @@ class CollectorServer:
         alternates it per level — the reference's ``gc_sender`` flag,
         rpc.rs:20-23 — so garbling cost splits across the servers); each
         direction runs its own OT-extension session (``_setup_secure``).
-        Every data-plane message is ONE packed array, and the b2a payloads
-        ride the garbled batch under the OUTPUT wire labels
-        (secure.gb_step_fused), so a level is ONE protocol round trip —
-        ev u -> gb batch+cts — with exactly one device fetch per message:
-        through a remote-chip tunnel each fetch is a full round trip, so
-        fetch count, not byte count, is the floor.  (The reference runs
-        GC then a separate OT round here, collect.rs:419-482.)"""
+        Every data-plane message is ONE packed array and a level is ONE
+        protocol round trip with exactly one device fetch per message
+        (through a remote-chip tunnel each fetch is a full round trip, so
+        fetch count, not byte count, is the floor): at S = 2 the level is
+        ev u -> 1-of-4 payload table (secure.gb_step_ot4 — no circuit);
+        for S > 2 it is ev u -> gb batch+cts with the b2a payloads riding
+        the garbled batch under the OUTPUT wire labels
+        (secure.gb_step_fused).  (The reference runs GC then a separate
+        OT round here, collect.rs:419-482.)"""
         t0 = time.perf_counter()
         packed, self._children = collect.expand_share_bits(
             self.keys, self.frontier, level, want_children=not last
