@@ -833,7 +833,8 @@ def bench_secure_device(n=65536, L=64, f_bucket=4, with_l512=True):
     def _contended(x):
         return x is not None and x / best_trusted > 8
 
-    if _contended(results["fe62"]) or _contended(best_gc_path):
+    if (_contended(results["fe62"]) or _contended(best_gc_path)
+            or _contended(best_xla_gc)):
         time.sleep(75)
         run_r = level_fn(FE62)
         run_r(k0, f0, k1, f1, 0)
@@ -844,6 +845,15 @@ def bench_secure_device(n=65536, L=64, f_bucket=4, with_l512=True):
             run_g2(k0, f0, k1, f1, 0)
             best_gc_path = min(best_gc_path,
                                _lvl_seconds(run_g2, k0, f0, k1, f1, 0))
+        if best_xla_gc is not None:
+            gcmod.GC_PALLAS = False
+            try:
+                run_x2 = level_fn(FE62, eq_ot4=False)
+                run_x2(k0, f0, k1, f1, 0)
+                best_xla_gc = min(best_xla_gc,
+                                  _lvl_seconds(run_x2, k0, f0, k1, f1, 0))
+            finally:
+                gcmod.GC_PALLAS = True
         best_trusted = min(best_trusted,
                            _lvl_seconds(trusted_level, k0, f0, k1, f1, 0))
         out_extra["contention_retry"] = True

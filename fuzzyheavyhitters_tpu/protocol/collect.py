@@ -404,6 +404,28 @@ def counts_by_pattern(
     return jnp.sum(eq, axis=1, dtype=jnp.uint32)  # [F, 2^d]
 
 
+def to_interleaved(states: EvalState) -> EvalState:
+    """Plane-major frontier state (seed [4, d, 2, F, N], bits [d, 2, F, N])
+    -> interleaved ([F, N, d, 2, 4] / [F, N, d, 2]).  The single source of
+    truth for the engine-edge transposes (used by :func:`advance` and the
+    checkpoint restore's cross-engine conversion)."""
+    return EvalState(
+        seed=jnp.transpose(states.seed, (3, 4, 1, 2, 0)),
+        bit=jnp.transpose(states.bit, (2, 3, 0, 1)),
+        y_bit=jnp.transpose(states.y_bit, (2, 3, 0, 1)),
+    )
+
+
+def to_planar(states: EvalState) -> EvalState:
+    """Inverse of :func:`to_interleaved` (the bit transpose is involutive;
+    the seed one is its inverse permutation)."""
+    return EvalState(
+        seed=jnp.transpose(states.seed, (4, 2, 3, 0, 1)),
+        bit=jnp.transpose(states.bit, (2, 3, 0, 1)),
+        y_bit=jnp.transpose(states.y_bit, (2, 3, 0, 1)),
+    )
+
+
 def advance(
     keys: IbDcfKeyBatch,
     frontier: Frontier,
@@ -430,23 +452,13 @@ def advance(
     """
     planar = _expand_engine()
     if planar:  # plane-major [4,d,2,F,N]/[d,2,F,N] -> interleaved
-        st = frontier.states
-        frontier = frontier._replace(states=EvalState(
-            seed=jnp.transpose(st.seed, (3, 4, 1, 2, 0)),
-            bit=jnp.transpose(st.bit, (2, 3, 0, 1)),
-            y_bit=jnp.transpose(st.y_bit, (2, 3, 0, 1)),
-        ))
+        frontier = frontier._replace(states=to_interleaved(frontier.states))
     out = _advance_jit(
         keys, frontier, level, parent_idx, pattern_bits, n_alive,
         prg.DERIVED_BITS,
     )
     if planar:
-        st = out.states
-        out = out._replace(states=EvalState(
-            seed=jnp.transpose(st.seed, (4, 2, 3, 0, 1)),
-            bit=jnp.transpose(st.bit, (2, 3, 0, 1)),
-            y_bit=jnp.transpose(st.y_bit, (2, 3, 0, 1)),
-        ))
+        out = out._replace(states=to_planar(out.states))
     return out
 
 

@@ -245,18 +245,136 @@ class Leader:
         self._last_counts = counts[parent[:n_alive], pattern[:n_alive]]
         return n_alive
 
-    def run(self, nreqs: int, threshold: float) -> CrawlResult:
+    def run(
+        self,
+        nreqs: int,
+        threshold: float,
+        checkpoint_path: str | None = None,
+        checkpoint_every: int = 64,
+        resume: bool = False,
+    ) -> CrawlResult:
         """Full crawl: init + data_len levels + final reconstruction
-        (ref: leader.rs:417-438 then final_shares at :282-297)."""
-        self.tree_init()
-        for level in range(self.data_len):
+        (ref: leader.rs:417-438 then final_shares at :282-297).
+
+        ``checkpoint_path`` + ``checkpoint_every`` persist the crawl state
+        every N completed levels (see :meth:`checkpoint`); ``resume=True``
+        restores from that file (if present) and continues from the next
+        level instead of starting over — a 512-level flagship crawl is
+        minutes of wall-clock, and the reference offers nothing but a full
+        restart on interruption (its only recovery verb is ``reset``,
+        server.rs:64-69).  Keys are NOT in the checkpoint (they are the
+        bulk of the bytes and the caller already holds them); construct
+        the Leader with the same key batches before resuming."""
+        import os
+
+        if (resume and checkpoint_path is not None
+                and os.path.exists(checkpoint_path)):
+            start = self.restore(checkpoint_path)
+        else:
+            start = 0
+            self.tree_init()
+        # cadence clamped so SHORT crawls still checkpoint mid-crawl: with
+        # the raw default (64) a data_len <= 64 run would only ever hit
+        # the final level — which the guard below rightly skips (a
+        # finished crawl has nothing to resume) — and silently write
+        # nothing at all
+        every = min(checkpoint_every, max(1, self.data_len // 2))
+        for level in range(start, self.data_len):
             n = self.run_level(level, nreqs, threshold)
             if n == 0:
                 return CrawlResult(
                     paths=np.zeros((0, self.n_dims, level + 1), bool),
                     counts=np.zeros(0, np.uint32),
                 )
+            if (
+                checkpoint_path is not None
+                and level < self.data_len - 1
+                and (level + 1) % every == 0
+            ):
+                self.checkpoint(checkpoint_path, level)
         return CrawlResult(paths=self.paths, counts=self._last_counts)
+
+    # -- checkpoint / resume -------------------------------------------------
+
+    def checkpoint(self, path: str, level: int) -> None:
+        """Persist the crawl state AFTER ``level`` completed: both servers'
+        frontier states + liveness flags, the leader's path bookkeeping,
+        and the state LAYOUT (the planar Pallas engine and the interleaved
+        XLA engine shape the frontier differently — collect.Frontier); a
+        restore under the other engine converts.  Written atomically
+        (tmp + rename) so an interruption mid-write never corrupts the
+        previous checkpoint."""
+        import os
+
+        planar = collect._expand_engine()
+        blob = {
+            "level": np.int64(level),
+            "planar": np.bool_(planar),
+            "paths": self.paths,
+            "n_nodes": np.int64(self.n_nodes),
+            "last_counts": np.asarray(self._last_counts),
+            "meta": np.array(
+                [self.n_dims, self.data_len, self.f_max, self.min_bucket],
+                np.int64,
+            ),
+        }
+        for i, s in enumerate((self.server0, self.server1)):
+            st = s.frontier.states
+            blob[f"s{i}_seed"] = np.asarray(st.seed)
+            blob[f"s{i}_bit"] = np.asarray(st.bit)
+            blob[f"s{i}_y_bit"] = np.asarray(st.y_bit)
+            blob[f"s{i}_alive"] = np.asarray(s.frontier.alive)
+            blob[f"s{i}_alive_keys"] = np.asarray(s.alive_keys)
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **blob)
+        os.replace(tmp, path)
+
+    def restore(self, path: str) -> int:
+        """Load a :meth:`checkpoint` and return the NEXT level to run.
+        The Leader must be constructed with the same shape parameters (and
+        the same key batches) as the checkpointing run."""
+        from ..ops.ibdcf import EvalState
+
+        z = np.load(path)
+        meta = z["meta"]
+        want = [self.n_dims, self.data_len, self.f_max, self.min_bucket]
+        if list(meta) != want:
+            raise ValueError(
+                f"checkpoint shape {list(meta)} != leader shape {want}"
+            )
+        saved_planar = bool(z["planar"])
+        planar = collect._expand_engine()
+        for i, s in enumerate((self.server0, self.server1)):
+            states = EvalState(
+                seed=jax.device_put(z[f"s{i}_seed"]),
+                bit=jax.device_put(z[f"s{i}_bit"]),
+                y_bit=jax.device_put(z[f"s{i}_y_bit"]),
+            )
+            if saved_planar != planar:
+                states = _convert_layout(states, saved_planar)
+            s.frontier = collect.Frontier(
+                states=states, alive=jax.device_put(z[f"s{i}_alive"])
+            )
+            s.children = None
+            s.alive_keys = z[f"s{i}_alive_keys"]
+        self.paths = z["paths"]
+        self.n_nodes = int(z["n_nodes"])
+        self._last_counts = z["last_counts"]
+        self._win = {}
+        self._win_next = {}
+        return int(z["level"]) + 1
+
+
+def _convert_layout(states, from_planar: bool):
+    """Frontier EvalState layout conversion for cross-engine checkpoint
+    restore — delegates to :func:`collect.to_interleaved` /
+    :func:`collect.to_planar`, the one source of truth for the engine-edge
+    transposes.  Converting there and back is the identity."""
+    return (
+        collect.to_interleaved(states) if from_planar
+        else collect.to_planar(states)
+    )
 
 
 def make_servers(

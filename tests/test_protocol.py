@@ -177,6 +177,83 @@ def test_streamed_crawl_matches_resident(rng, on_chip):
         assert res.paths.shape[0] >= 1
 
 
+def test_checkpoint_resume_matches_uninterrupted(rng, tmp_path):
+    """A crawl interrupted after a mid-crawl checkpoint and resumed by a
+    FRESH leader (same keys, state restored from disk) produces the exact
+    uninterrupted heavy hitters — including the leader-side path
+    bookkeeping and liveness flags the checkpoint must carry."""
+    L, d, n, ball, threshold = 8, 1, 40, 2, 0.1
+    centers = rng.integers(0, 1 << L, size=(4, d))
+    pts = np.clip(
+        centers[rng.integers(0, 4, size=n)] + rng.integers(-1, 2, size=(n, d)),
+        0, (1 << L) - 1,
+    )
+    pts_bits = np.array(
+        [[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts]
+    )
+    krng = np.random.default_rng(99)
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, ball, krng, engine="np")
+
+    def as_dict(res):
+        return {
+            tuple(int(v) for v in r): int(c)
+            for r, c in zip(res.decode_ints(), res.counts)
+        }
+
+    s0, s1 = driver.make_servers(k0, k1)
+    want = as_dict(
+        driver.Leader(s0, s1, n_dims=d, data_len=L, f_max=64).run(
+            nreqs=n, threshold=threshold
+        )
+    )
+    assert want  # non-degenerate scenario
+
+    ck = str(tmp_path / "crawl.npz")
+    # first leader: run HALF the levels with periodic checkpoints, then
+    # "crash" (simply stop driving it)
+    s0a, s1a = driver.make_servers(k0, k1)
+    lead_a = driver.Leader(s0a, s1a, n_dims=d, data_len=L, f_max=64)
+    lead_a.tree_init()
+    for level in range(L // 2):
+        assert lead_a.run_level(level, nreqs=n, threshold=threshold) > 0
+    lead_a.checkpoint(ck, L // 2 - 1)
+
+    # fresh leader over the SAME keys resumes from disk
+    s0b, s1b = driver.make_servers(k0, k1)
+    lead_b = driver.Leader(s0b, s1b, n_dims=d, data_len=L, f_max=64)
+    got = as_dict(
+        lead_b.run(nreqs=n, threshold=threshold, checkpoint_path=ck, resume=True)
+    )
+    assert got == want
+
+    # shape-mismatch guard: a different leader shape must refuse the file
+    s0c, s1c = driver.make_servers(k0, k1)
+    lead_c = driver.Leader(s0c, s1c, n_dims=d, data_len=L, f_max=128)
+    with pytest.raises(ValueError, match="checkpoint shape"):
+        lead_c.restore(ck)
+
+
+def test_checkpoint_layout_conversion_roundtrip(rng):
+    """_convert_layout is the involutive planar<->interleaved transpose
+    pair (the engine edges of collect.advance): converting a synthetic
+    interleaved state to planar and back is the identity, and the planar
+    form has the documented [4, d, 2, F, N] / [d, 2, F, N] shapes."""
+    from fuzzyheavyhitters_tpu.ops.ibdcf import EvalState
+
+    F, N, d = 3, 7, 2
+    st = EvalState(
+        seed=rng.integers(0, 2**32, size=(F, N, d, 2, 4), dtype=np.uint32),
+        bit=rng.integers(0, 2, size=(F, N, d, 2)).astype(bool),
+        y_bit=rng.integers(0, 2, size=(F, N, d, 2)).astype(bool),
+    )
+    planar = driver._convert_layout(st, from_planar=False)
+    assert planar.seed.shape == (4, d, 2, F, N)
+    assert planar.bit.shape == (d, 2, F, N)
+    back = driver._convert_layout(planar, from_planar=True)
+    for a, b in zip(st, back):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_covid_crawl_end_to_end(rng, tmp_path):
     """COVID workload driven end to end: the f64-bit domain (data_len=64,
     n_dims=2, ref: sample_covid_data.rs:32-35) through the full driver
