@@ -19,13 +19,15 @@ Level-loop semantics mirror the reference leader (ref: leader.rs:185-297):
 
 from __future__ import annotations
 
+import hashlib
+import os
 from dataclasses import dataclass, field
 
 import jax
 import numpy as np
 from jax import lax
 
-from ..ops.ibdcf import IbDcfKeyBatch
+from ..ops.ibdcf import EvalState, IbDcfKeyBatch
 from . import collect
 
 
@@ -264,15 +266,22 @@ class Leader:
         restart on interruption (its only recovery verb is ``reset``,
         server.rs:64-69).  Keys are NOT in the checkpoint (they are the
         bulk of the bytes and the caller already holds them); construct
-        the Leader with the same key batches before resuming."""
-        import os
-
+        the Leader with the same key batches before resuming.  A completed
+        crawl REMOVES its checkpoint file, so the natural crash-safe
+        invocation (always pass the same path with ``resume=True``) starts
+        the next crawl fresh instead of silently resuming a finished one."""
         if (resume and checkpoint_path is not None
                 and os.path.exists(checkpoint_path)):
-            start = self.restore(checkpoint_path)
+            start = self.restore(checkpoint_path, nreqs, threshold)
         else:
             start = 0
             self.tree_init()
+
+        def done(result):
+            if checkpoint_path is not None and os.path.exists(checkpoint_path):
+                os.remove(checkpoint_path)
+            return result
+
         # cadence clamped so SHORT crawls still checkpoint mid-crawl: with
         # the raw default (64) a data_len <= 64 run would only ever hit
         # the final level — which the guard below rightly skips (a
@@ -282,30 +291,44 @@ class Leader:
         for level in range(start, self.data_len):
             n = self.run_level(level, nreqs, threshold)
             if n == 0:
-                return CrawlResult(
+                return done(CrawlResult(
                     paths=np.zeros((0, self.n_dims, level + 1), bool),
                     counts=np.zeros(0, np.uint32),
-                )
+                ))
             if (
                 checkpoint_path is not None
                 and level < self.data_len - 1
                 and (level + 1) % every == 0
             ):
-                self.checkpoint(checkpoint_path, level)
-        return CrawlResult(paths=self.paths, counts=self._last_counts)
+                self.checkpoint(checkpoint_path, level, nreqs, threshold)
+        return done(CrawlResult(paths=self.paths, counts=self._last_counts))
 
     # -- checkpoint / resume -------------------------------------------------
 
-    def checkpoint(self, path: str, level: int) -> None:
+    def _key_fingerprint(self) -> np.ndarray:
+        """SHA-256 over both servers' key identities (key_idx + root
+        seeds): a checkpoint resumed against DIFFERENT key batches would
+        evaluate one crawl's frontier states under another crawl's keys
+        and return silently wrong counts — turn that into a hard error."""
+        h = hashlib.sha256()
+        for s in (self.server0, self.server1):
+            h.update(np.ascontiguousarray(np.asarray(s.keys.key_idx)))
+            h.update(np.ascontiguousarray(np.asarray(s.keys.root_seed)))
+        return np.frombuffer(h.digest(), np.uint8)
+
+    def checkpoint(
+        self, path: str, level: int,
+        nreqs: int | None = None, threshold: float | None = None,
+    ) -> None:
         """Persist the crawl state AFTER ``level`` completed: both servers'
         frontier states + liveness flags, the leader's path bookkeeping,
-        and the state LAYOUT (the planar Pallas engine and the interleaved
-        XLA engine shape the frontier differently — collect.Frontier); a
-        restore under the other engine converts.  Written atomically
-        (tmp + rename) so an interruption mid-write never corrupts the
-        previous checkpoint."""
-        import os
-
+        the state LAYOUT (the planar Pallas engine and the interleaved
+        XLA engine shape the frontier differently — collect.Frontier; a
+        restore under the other engine converts), a key fingerprint, and —
+        when called from :meth:`run` — the crawl parameters, so a resume
+        under different keys/nreqs/threshold refuses instead of mixing
+        pruning regimes.  Written atomically (tmp + rename) so an
+        interruption mid-write never corrupts the previous checkpoint."""
         planar = collect._expand_engine()
         blob = {
             "level": np.int64(level),
@@ -317,7 +340,10 @@ class Leader:
                 [self.n_dims, self.data_len, self.f_max, self.min_bucket],
                 np.int64,
             ),
+            "key_fp": self._key_fingerprint(),
         }
+        if nreqs is not None and threshold is not None:
+            blob["params"] = np.array([float(nreqs), float(threshold)])
         for i, s in enumerate((self.server0, self.server1)):
             st = s.frontier.states
             blob[f"s{i}_seed"] = np.asarray(st.seed)
@@ -330,12 +356,14 @@ class Leader:
             np.savez(f, **blob)
         os.replace(tmp, path)
 
-    def restore(self, path: str) -> int:
+    def restore(
+        self, path: str,
+        nreqs: int | None = None, threshold: float | None = None,
+    ) -> int:
         """Load a :meth:`checkpoint` and return the NEXT level to run.
-        The Leader must be constructed with the same shape parameters (and
-        the same key batches) as the checkpointing run."""
-        from ..ops.ibdcf import EvalState
-
+        Refuses a checkpoint whose shape, key fingerprint, or (when both
+        sides recorded them) crawl parameters differ from this Leader's —
+        every mismatch would otherwise produce silently wrong hitters."""
         z = np.load(path)
         meta = z["meta"]
         want = [self.n_dims, self.data_len, self.f_max, self.min_bucket]
@@ -343,6 +371,17 @@ class Leader:
             raise ValueError(
                 f"checkpoint shape {list(meta)} != leader shape {want}"
             )
+        if not np.array_equal(z["key_fp"], self._key_fingerprint()):
+            raise ValueError(
+                "checkpoint was written under different key batches"
+            )
+        if "params" in z and nreqs is not None and threshold is not None:
+            saved = z["params"]
+            if saved[0] != float(nreqs) or saved[1] != float(threshold):
+                raise ValueError(
+                    f"checkpoint crawl params (nreqs, threshold) = "
+                    f"({saved[0]:g}, {saved[1]:g}) != ({nreqs}, {threshold})"
+                )
         saved_planar = bool(z["planar"])
         planar = collect._expand_engine()
         for i, s in enumerate((self.server0, self.server1)):

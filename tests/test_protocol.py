@@ -218,19 +218,53 @@ def test_checkpoint_resume_matches_uninterrupted(rng, tmp_path):
         assert lead_a.run_level(level, nreqs=n, threshold=threshold) > 0
     lead_a.checkpoint(ck, L // 2 - 1)
 
-    # fresh leader over the SAME keys resumes from disk
+    # resume-safety guards, checked against the on-disk file BEFORE the
+    # successful resume consumes it:
+    # (a) different leader shape -> refused
+    s0c, s1c = driver.make_servers(k0, k1)
+    lead_c = driver.Leader(s0c, s1c, n_dims=d, data_len=L, f_max=128)
+    with pytest.raises(ValueError, match="checkpoint shape"):
+        lead_c.restore(ck)
+    # (b) same shape, DIFFERENT key batches -> refused (resuming crawl A's
+    # frontier under crawl B's keys would yield silently wrong counts)
+    ok0, ok1 = ibdcf.gen_l_inf_ball(
+        pts_bits, ball, np.random.default_rng(7), engine="np"
+    )
+    s0d, s1d = driver.make_servers(ok0, ok1)
+    lead_d = driver.Leader(s0d, s1d, n_dims=d, data_len=L, f_max=64)
+    with pytest.raises(ValueError, match="different key batches"):
+        lead_d.restore(ck)
+
+    # fresh leader over the SAME keys resumes from disk; run()-written
+    # checkpoints also carry (nreqs, threshold), so a mid-crawl file from
+    # run() refuses a resume under a different pruning regime — exercise
+    # that via a run()-produced checkpoint after this resume completes
+    import os
+
     s0b, s1b = driver.make_servers(k0, k1)
     lead_b = driver.Leader(s0b, s1b, n_dims=d, data_len=L, f_max=64)
     got = as_dict(
         lead_b.run(nreqs=n, threshold=threshold, checkpoint_path=ck, resume=True)
     )
     assert got == want
+    # (c) a COMPLETED crawl removes its checkpoint: the always-resume
+    # invocation starts the next crawl fresh instead of resuming this one
+    assert not os.path.exists(ck)
 
-    # shape-mismatch guard: a different leader shape must refuse the file
-    s0c, s1c = driver.make_servers(k0, k1)
-    lead_c = driver.Leader(s0c, s1c, n_dims=d, data_len=L, f_max=128)
-    with pytest.raises(ValueError, match="checkpoint shape"):
-        lead_c.restore(ck)
+    # (d) param guard: a run()-written mid-crawl checkpoint refuses resume
+    # under a different threshold
+    s0e, s1e = driver.make_servers(k0, k1)
+    lead_e = driver.Leader(s0e, s1e, n_dims=d, data_len=L, f_max=64)
+    lead_e.tree_init()
+    for level in range(L // 2):
+        lead_e.run_level(level, nreqs=n, threshold=threshold)
+    lead_e.checkpoint(ck, L // 2 - 1, nreqs=n, threshold=threshold)
+    s0f, s1f = driver.make_servers(k0, k1)
+    lead_f = driver.Leader(s0f, s1f, n_dims=d, data_len=L, f_max=64)
+    with pytest.raises(ValueError, match="crawl params"):
+        lead_f.run(
+            nreqs=n, threshold=0.5, checkpoint_path=ck, resume=True
+        )
 
 
 def test_checkpoint_layout_conversion_roundtrip(rng):
