@@ -309,12 +309,17 @@ class Leader:
         """SHA-256 over both servers' key identities (key_idx + root
         seeds): a checkpoint resumed against DIFFERENT key batches would
         evaluate one crawl's frontier states under another crawl's keys
-        and return silently wrong counts — turn that into a hard error."""
-        h = hashlib.sha256()
-        for s in (self.server0, self.server1):
-            h.update(np.ascontiguousarray(np.asarray(s.keys.key_idx)))
-            h.update(np.ascontiguousarray(np.asarray(s.keys.root_seed)))
-        return np.frombuffer(h.digest(), np.uint8)
+        and return silently wrong counts — turn that into a hard error.
+        Cached: keys are immutable for the crawl's lifetime, and the
+        device->host fetch behind the hash is tunnel-priced."""
+        fp = getattr(self, "_key_fp", None)
+        if fp is None:
+            h = hashlib.sha256()
+            for s in (self.server0, self.server1):
+                h.update(np.ascontiguousarray(np.asarray(s.keys.key_idx)))
+                h.update(np.ascontiguousarray(np.asarray(s.keys.root_seed)))
+            fp = self._key_fp = np.frombuffer(h.digest(), np.uint8)
+        return fp
 
     def checkpoint(
         self, path: str, level: int,
@@ -370,6 +375,11 @@ class Leader:
         if list(meta) != want:
             raise ValueError(
                 f"checkpoint shape {list(meta)} != leader shape {want}"
+            )
+        if "key_fp" not in z:
+            raise ValueError(
+                "checkpoint predates the key-fingerprint format — "
+                "re-run the crawl from the start"
             )
         if not np.array_equal(z["key_fp"], self._key_fingerprint()):
             raise ValueError(

@@ -18,9 +18,12 @@ import pytest
 @pytest.fixture(autouse=True)
 def _module_cpu(cpu_default):
     # importing bench flips prg.CHACHA_UNROLL to the chip-friendly unrolled
-    # form; force the scan form back BOTH for this test's compiles and for
+    # form; import it FIRST (so its module-level assignment has happened),
+    # then force the scan form back both for this test's compiles and for
     # the rest of the suite (the flag is process-global and read at trace
     # time — leaking True makes every later CPU compile pathologically slow)
+    import bench  # noqa: F401
+
     from fuzzyheavyhitters_tpu.ops import prg
 
     prg.CHACHA_UNROLL = False

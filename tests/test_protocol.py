@@ -267,6 +267,60 @@ def test_checkpoint_resume_matches_uninterrupted(rng, tmp_path):
         )
 
 
+def test_checkpoint_resume_streaming_mode(rng, tmp_path):
+    """Checkpoint/resume under the STREAMING crawl mode (host-resident
+    keys, per-level cw upload — the mode the flagship 512-level runs use):
+    a streamed crawl interrupted mid-crawl and resumed by a fresh streamed
+    leader matches the uninterrupted resident-key result, with the cw
+    window caches rebuilt lazily after restore."""
+    L, d, n = 8, 1, 60
+    centers = rng.integers(0, 1 << L, size=(4, d))
+    pts = np.clip(
+        centers[rng.integers(0, 4, size=n)] + rng.integers(-1, 2, size=(n, d)),
+        0, (1 << L) - 1,
+    )
+    pts_bits = np.array(
+        [[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts]
+    )
+    k0, k1 = ibdcf.gen_l_inf_ball(
+        pts_bits, 2, np.random.default_rng(5), engine="np"
+    )
+    host = lambda k: type(k)(*[np.asarray(x) for x in k])
+
+    def as_dict(res):
+        return {
+            tuple(int(v) for v in r): int(c)
+            for r, c in zip(res.decode_ints(), res.counts)
+        }
+
+    s0, s1 = driver.make_servers(k0, k1)
+    want = as_dict(
+        driver.Leader(s0, s1, n_dims=d, data_len=L, f_max=64).run(
+            nreqs=n, threshold=0.1
+        )
+    )
+    assert want
+
+    ck = str(tmp_path / "stream.npz")
+    t0, t1 = driver.make_servers(host(k0), host(k1))
+    lead_a = driver.Leader(
+        t0, t1, n_dims=d, data_len=L, f_max=64, stream=True, stream_window=4
+    )
+    lead_a.tree_init()
+    for level in range(5):  # crosses a stream-window boundary (4)
+        assert lead_a.run_level(level, nreqs=n, threshold=0.1) > 0
+    lead_a.checkpoint(ck, 4)
+
+    u0, u1 = driver.make_servers(host(k0), host(k1))
+    lead_b = driver.Leader(
+        u0, u1, n_dims=d, data_len=L, f_max=64, stream=True, stream_window=4
+    )
+    got = as_dict(
+        lead_b.run(nreqs=n, threshold=0.1, checkpoint_path=ck, resume=True)
+    )
+    assert got == want
+
+
 def test_checkpoint_layout_conversion_roundtrip(rng):
     """_convert_layout is the involutive planar<->interleaved transpose
     pair (the engine edges of collect.advance): converting a synthetic
