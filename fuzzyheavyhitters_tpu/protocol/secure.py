@@ -286,9 +286,15 @@ def ot4_decrypt(t_rows, y_flat, cts, n_words: int, idx_offset):
     comb = t_rows[:, 0] ^ otext.gf128_double(t_rows[:, 1])  # [B, 4]
     pad = otext.ot_hash(comb, n_words, idx_offset, domain=_OT4_DOMAIN)
     y_int = y_flat[:, 0].astype(jnp.uint32) + 2 * y_flat[:, 1].astype(jnp.uint32)
-    ct = jnp.take_along_axis(
-        jnp.asarray(cts, jnp.uint32), y_int[None, :, None], axis=0
-    )[0]
+    # one-hot select instead of take_along_axis: the gather lowers poorly
+    # on TPU (measured 1.5x slower at the flagship 524288-test batch)
+    sel = (jnp.arange(4, dtype=jnp.uint32)[:, None] == y_int[None]).astype(
+        jnp.uint32
+    )
+    ct = jnp.sum(
+        jnp.asarray(cts, jnp.uint32) * sel[..., None], axis=0,
+        dtype=jnp.uint32,
+    )
     return ct ^ pad
 
 
