@@ -34,6 +34,7 @@ throughput vs the reference's single-threaded AES-NI baseline
 """
 
 import json
+import os
 import time
 
 import numpy as np
@@ -460,17 +461,33 @@ def bench_crawl_hbm_max(rng, n=196608, L=512, sites=10000, threshold=0.001,
         n_alive = lead.run_level(lvl, nreqs=n, threshold=threshold)
         level_s.append(time.perf_counter() - t1)
         if lvl % 64 == 0:
-            print(f"level {lvl}: {n_alive} alive, "
-                  f"{level_s[-1]:.2f}s", flush=True)
+            from fuzzyheavyhitters_tpu import obs
+
+            obs.emit(
+                "bench.level", level=lvl, alive=int(n_alive),
+                seconds=round(level_s[-1], 2),
+            )
         if n_alive == 0:
             break
     dt = time.perf_counter() - t0
     med = float(np.median(level_s))
+    # per-phase split from the driver's telemetry registry (obs layer):
+    # fss = expand, field = counts/threshold, advance = frontier rebuild.
+    # Leaf phases only — the enclosing "level" span is their sum and
+    # would double-count for any consumer adding the reported phases.
+    rep_phases = lead.obs.report()["phases"]
+    phase_seconds = {
+        k: round(rep_phases[k]["seconds"], 2)
+        for k in ("fss", "field", "advance")
+        if k in rep_phases
+    }
     return {
         "n_clients": n,
         "data_len": L,
         "num_sites": sites,
         "threshold": threshold,
+        "phase_seconds": phase_seconds,
+        "device_fetches": int(lead.obs.counter_value("device_fetches")),
         "hitters": int(lead.n_nodes),
         "crawl_seconds_e2e": round(dt, 1),
         "clients_per_sec_e2e": round(n / dt, 1),
@@ -573,8 +590,6 @@ def bench_secure(n=1024, L=12, port=39831):
     ``bench_secure_device`` is the adjacent-chip number.
     Ref seam: collect.rs:419-482 inside tree_crawl."""
     import asyncio
-    import contextlib
-    import io
 
     from fuzzyheavyhitters_tpu.ops import ibdcf
     from fuzzyheavyhitters_tpu.protocol import rpc
@@ -606,11 +621,18 @@ def bench_secure(n=1024, L=12, port=39831):
         t = time.perf_counter()
         res = await lead.run(n)
         dt = time.perf_counter() - t
-        return dt, int(res.paths.shape[0]), int(s0._gc_tests), list(s0._phase_seconds)
+        # server 0's telemetry registry snapshot — the machine-readable
+        # successor of the phase-timer stdout scrape
+        return dt, int(res.paths.shape[0]), s0.obs.report()
 
-    with contextlib.redirect_stdout(io.StringIO()):  # phase-timer prints
-        dt, hitters, gc_tests, phases = asyncio.run(run())
-    fss, gcot, fld = (round(p, 3) for p in phases)
+    dt, hitters, rep = asyncio.run(run())
+    phases, ctrs = rep["phases"], rep["counters"]
+    zero = {"seconds": 0.0, "total": 0}
+    fss, gcot, fld = (
+        round(phases.get(k, zero)["seconds"], 3)
+        for k in ("fss", "gc_ot", "field")
+    )
+    gc_tests = int(ctrs.get("gc_tests", zero)["total"])
     # the e2e floor: every device->host fetch in the serial 2PC message
     # flow costs one of these (≈6 per level after round-4's packing)
     import jax.numpy as jnp
@@ -637,6 +659,15 @@ def bench_secure(n=1024, L=12, port=39831):
         "phase_gc_ot_seconds": gcot,
         "phase_field_seconds": fld,
         "device_fetch_rtt_ms": round(rtt * 1000, 1),
+        # data-plane accounting from the same registry: fetch COUNT is the
+        # remote-tunnel floor the rpc.py docstring states — now measured
+        "device_fetches": int(ctrs.get("device_fetches", zero)["total"]),
+        "data_plane_mbytes_sent": round(
+            ctrs.get("data_bytes_sent", zero)["total"] / 1e6, 2
+        ),
+        "data_plane_mbytes_recv": round(
+            ctrs.get("data_bytes_recv", zero)["total"] / 1e6, 2
+        ),
     }
 
 
@@ -833,13 +864,17 @@ def bench_secure_device(n=65536, L=64, f_bucket=4, with_l512=True):
     def _contended(x):
         return x is not None and x / best_trusted > 8
 
-    if (_contended(results["fe62"]) or _contended(best_gc_path)
-            or _contended(best_xla_gc)):
+    if (_contended(results["fe62"]) or _contended(results["f255"])
+            or _contended(best_gc_path) or _contended(best_xla_gc)):
         time.sleep(75)
         run_r = level_fn(FE62)
         run_r(k0, f0, k1, f1, 0)
         results["fe62"] = min(results["fe62"],
                               _lvl_seconds(run_r, k0, f0, k1, f1, 0))
+        run_r5 = level_fn(F255)
+        run_r5(k0, f0, k1, f1, 0)
+        results["f255"] = min(results["f255"],
+                              _lvl_seconds(run_r5, k0, f0, k1, f1, 0))
         if best_gc_path is not None:
             run_g2 = level_fn(FE62, eq_ot4=False)
             run_g2(k0, f0, k1, f1, 0)
@@ -1048,27 +1083,153 @@ def bench_upload(n=1_000_000, L=16, batch=4000, port=39731):
     }
 
 
+# sections of the run that already finished, keyed by metric name — what
+# the SIGTERM handler dumps so a timed-out bench still reports them
+_PARTIAL: dict = {}
+
+
+def _dump_partial(reason: str = "sigterm") -> dict:
+    """Last-gasp artifact: finished sections plus the telemetry run
+    report — printed as the LAST stdout line (the bench output contract)
+    and written to ``$FHH_RUN_REPORT`` when set."""
+    from fuzzyheavyhitters_tpu import obs
+
+    rep = {
+        "partial": True,
+        "reason": reason,
+        "results": dict(_PARTIAL),
+        "telemetry": obs.run_report(),
+    }
+    print(json.dumps(rep), flush=True)
+    try:
+        obs.maybe_write_run_report()
+    except Exception:
+        pass
+    return rep
+
+
+def _install_sigterm_partial() -> None:
+    """SIGTERM -> partial results + telemetry report on stdout, exit 124.
+    Installed by main() AND prepended to every child bench process: the
+    driver's ``timeout`` command TERMs the run, and before this an rc=124
+    bench left nothing but an XLA warning (BENCH_r05) — now it leaves the
+    per-level phase seconds and byte counts accumulated up to the kill.
+    Also starts the heartbeat: a wedged bench streams the active phase +
+    level to stderr every 60 s, so even a SIGKILL leaves a trail naming
+    where it died.
+
+    The handler only raises SystemExit; the dump runs from an atexit hook
+    once the stack has unwound.  Dumping inside the handler would grab the
+    non-reentrant registry/log locks from a signal frame — if the TERM
+    lands while the interrupted code holds one (every obs call does,
+    briefly), the dump deadlocks until the parent's grace expires and the
+    SIGKILL destroys the artifact this exists to save."""
+    import atexit
+    import signal
+    import sys
+
+    from fuzzyheavyhitters_tpu import obs
+
+    obs.start_heartbeat(60.0)
+    terminated = []
+
+    def handler(_sig, _frame):
+        terminated.append("sigterm")
+        raise SystemExit(124)
+
+    def on_exit():
+        if terminated:  # normal exits keep the last-stdout-line contract
+            _dump_partial(terminated[0])
+        else:
+            # the $FHH_RUN_REPORT artifact is promised for EVERY run, not
+            # just killed ones — write it without touching stdout
+            try:
+                obs.maybe_write_run_report()
+            except Exception:
+                pass
+
+    # Ctrl-C must leave the artifact too: SIGINT has no handler here (the
+    # default KeyboardInterrupt keeps child teardown working), but one
+    # reaching the top level runs excepthook before atexit — mark it so
+    # on_exit dumps the finished sections + telemetry it would otherwise
+    # silently discard
+    prev_hook = sys.excepthook
+
+    def hook(tp, val, tb):
+        if issubclass(tp, KeyboardInterrupt):
+            terminated.append("interrupt")
+        prev_hook(tp, val, tb)
+
+    sys.excepthook = hook
+    atexit.register(on_exit)
+    signal.signal(signal.SIGTERM, handler)
+
+
 def _subprocess_metric(code: str, timeout_s: int):
     """Run one benchmark in a child process with a hard timeout so a
     stalled accelerator tunnel (or a hung socket loop) can never take down
-    the whole bench run — the keygen headline must always print."""
+    the whole bench run — the keygen headline must always print.  On
+    timeout the child gets SIGTERM first (its handler prints partial
+    results + the telemetry report as its last stdout line) and SIGKILL
+    only if it ignores that for 20 s."""
     import subprocess
     import sys
 
+    code = "import bench; bench._install_sigterm_partial();" + code
+    # $FHH_RUN_REPORT belongs to the PARENT: a TERMed child would write
+    # the file too, and the parent's own exit dump then clobbers it.
+    # Child telemetry travels on the stdout contract (last JSON line)
+    # instead, which the parent folds into its partial dump.
+    env = {k: v for k, v in os.environ.items() if k != "FHH_RUN_REPORT"}
     try:
-        out = subprocess.run(
+        p = subprocess.Popen(
             [sys.executable, "-c", code],
-            capture_output=True,
-            timeout=timeout_s,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
             text=True,
             cwd=__file__.rsplit("/", 1)[0],
+            env=env,
         )
-        lines = out.stdout.strip().splitlines()
+        timed_out = False
+        try:
+            out, err = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            p.terminate()  # SIGTERM: the child dumps partial + telemetry
+            try:
+                out, err = p.communicate(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, err = p.communicate()
+        except BaseException:
+            # The parent is being torn down (driver SIGTERM -> SystemExit,
+            # Ctrl-C) while blocked in communicate(): pass TERM down so the
+            # grandchild stops crawling the accelerator and dumps its own
+            # partial + telemetry — folded into _PARTIAL so the parent's
+            # last-gasp dump (_dump_partial) carries the wedged section's
+            # phase/level accounting out with it.
+            p.terminate()
+            try:
+                out, _ = p.communicate(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            lines = (out or "").strip().splitlines()
+            if lines:
+                try:
+                    _PARTIAL["interrupted"] = json.loads(lines[-1])
+                except ValueError:
+                    _PARTIAL["interrupted"] = {"stdout_tail": lines[-1][:500]}
+            raise
+        lines = (out or "").strip().splitlines()
         if not lines:  # child died before printing — surface its stderr
-            tail = (out.stderr or "").strip().splitlines()[-3:]
-            return {"error": f"child rc={out.returncode}: " + " | ".join(tail)}
-        return json.loads(lines[-1])
-    except Exception as e:  # timeout, crash, parse failure
+            tail = (err or "").strip().splitlines()[-3:]
+            return {"error": f"child rc={p.returncode}: " + " | ".join(tail)}
+        res = json.loads(lines[-1])
+        if timed_out and isinstance(res, dict):
+            res.setdefault("error", f"timeout after {timeout_s}s")
+        return res
+    except Exception as e:  # spawn failure, parse failure
         return {"error": f"{type(e).__name__}: {e}"[:200]}
 
 
@@ -1078,8 +1239,10 @@ def main():
 
     from fuzzyheavyhitters_tpu.ops import ibdcf
 
+    _install_sigterm_partial()
     rng = np.random.default_rng(0)
     headline, sweep = bench_keygen(jax, jnp, ibdcf, rng)
+    _PARTIAL["keygen_sweep"] = sweep
     crawl = _subprocess_metric(
         "import json, numpy as np, bench;"
         "from fuzzyheavyhitters_tpu.ops import ibdcf;"
@@ -1088,6 +1251,7 @@ def main():
         " np.random.default_rng(0))))",
         timeout_s=540,
     )
+    _PARTIAL["crawl"] = crawl
     crawl_hbm_max = _subprocess_metric(
         "import json, numpy as np, bench;"
         "print(json.dumps(bench.bench_crawl_hbm_max(np.random.default_rng(17))))",
@@ -1096,37 +1260,44 @@ def main():
         # uploads do 200 MB/s) — budget for the slow-tunnel case
         timeout_s=2700,
     )
+    _PARTIAL["crawl_hbm_max"] = crawl_hbm_max
     secure = _subprocess_metric(
         "import json, bench;"
         "print(json.dumps(bench.bench_secure()))",
         timeout_s=540,
     )
+    _PARTIAL["secure"] = secure
     secure_device = _subprocess_metric(
         "import json, bench;"
         "print(json.dumps(bench.bench_secure_device()))",
         # headroom for the contention-retry path (see bench_secure_device)
         timeout_s=1500,
     )
+    _PARTIAL["secure_device"] = secure_device
     hbm = _subprocess_metric(
         "import json, bench;"
         "print(json.dumps(bench.bench_hbm()))",
         timeout_s=540,
     )
+    _PARTIAL["hbm"] = hbm
     covid = _subprocess_metric(
         "import json, bench;"
         "print(json.dumps(bench.bench_covid()))",
         timeout_s=540,
     )
+    _PARTIAL["covid"] = covid
     hash_margin = _subprocess_metric(
         "import json, bench;"
         "print(json.dumps(bench.bench_hash_margin()))",
         timeout_s=540,
     )
+    _PARTIAL["hash_margin"] = hash_margin
     upload = _subprocess_metric(
         "import json, bench;"
         "print(json.dumps(bench.bench_upload()))",
         timeout_s=540,
     )
+    _PARTIAL["upload"] = upload
     try:
         write_keygen_csv(sweep)
     except Exception:

@@ -7,6 +7,12 @@
 Flow (leader.rs:300-440): keygen throughput report, distribution-specific
 client sampling (zipf site strings with 8-bit augmentation, RideAustin
 coordinates, or COVID-geo), batched key upload, level loop, heavy-hitter CSV.
+
+Telemetry rides the obs layer (fuzzyheavyhitters_tpu/obs): structured log
+events instead of prints (JSON-lines via ``FHH_LOG_FORMAT=json``), a
+heartbeat thread naming the active phase/level, and — when
+``FHH_RUN_REPORT`` is set — an end-of-run machine-readable report with
+per-level phase seconds and data-plane accounting.
 """
 
 from __future__ import annotations
@@ -18,6 +24,7 @@ import time
 import jax
 import numpy as np
 
+from .. import obs
 from ..ops import ibdcf
 from ..protocol.leader_rpc import RpcLeader
 from ..protocol.rpc import CollectorClient
@@ -48,9 +55,14 @@ def keygen_report(cfg, rng, engine: str) -> None:
     jax.block_until_ready(k0)
     dt = time.perf_counter() - t0
     per_client = sum(np.asarray(x)[0].nbytes for x in k0)
-    print(f"Keygen engine: {engine}")
-    print(f"Key size: {per_client} bytes")
-    print(f"Generated {n} keys in {dt:.3f} seconds ({dt / n:.6f} sec/key)")
+    obs.emit(
+        "keygen.report",
+        engine=engine,
+        key_bytes=per_client,
+        n_keys=n,
+        seconds=round(dt, 3),
+        sec_per_key=round(dt / n, 6),
+    )
 
 
 async def amain() -> None:
@@ -74,12 +86,16 @@ async def _run(cfg, nreqs: int, rng) -> None:
     # fast keygen engine for the backend (amain's default_device(cpu)
     # context is visible to best_engine via utils.effective_platform)
     engine = ibdcf.best_engine()
-    print("Generating keys...")
+    reg = obs.default_registry()
     keygen_report(cfg, rng, engine)
 
-    print(f"{cfg.distribution} distribution sampling...")
-    pts = sample_points(cfg, nreqs, rng)
-    k0, k1 = ibdcf.gen_l_inf_ball(pts, cfg.ball_size, rng, engine=engine)
+    # each setup stage gets its own phase so the run report's keygen
+    # seconds mean keygen, not keygen+sampling+sketch
+    obs.emit("sampling", distribution=cfg.distribution, n=nreqs)
+    with reg.span("sampling"):
+        pts = sample_points(cfg, nreqs, rng)
+    with reg.span("keygen"):
+        k0, k1 = ibdcf.gen_l_inf_ball(pts, cfg.ball_size, rng, engine=engine)
 
     sk0 = sk1 = None
     if cfg.malicious:
@@ -95,7 +111,8 @@ async def _run(cfg, nreqs: int, rng) -> None:
             0, 2**32, size=(nreqs, cfg.n_dims, 2, 4), dtype=np.uint32
         )
         cseed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
-        sk0, sk1 = sketchmod.gen(seeds, pts, FE62, F255, cseed)
+        with reg.span("sketch_gen"):
+            sk0, sk1 = sketchmod.gen(seeds, pts, FE62, F255, cseed)
 
     h0, p0 = _split(cfg.server0)
     h1, p1 = _split(cfg.server1)
@@ -106,22 +123,28 @@ async def _run(cfg, nreqs: int, rng) -> None:
     lead = RpcLeader(cfg, c0, c1)
     t0 = time.perf_counter()
     await lead.upload_keys(k0, k1, sk0, sk1)
-    print(f"AddKeysDone in {time.perf_counter() - t0:.2f}s")
+    obs.emit("addkeys.done", seconds=round(time.perf_counter() - t0, 2))
 
     t0 = time.perf_counter()
     res = await lead.run(nreqs)
-    print(f"Crawl done in {time.perf_counter() - t0:.2f}s")
+    obs.emit("crawl.done", seconds=round(time.perf_counter() - t0, 2))
 
     for row, c in zip(res.decode_ints(), res.counts):
-        print(f"Final {row.tolist()} -> {int(c)}")
+        obs.emit("hitter", value=str(row.tolist()), count=int(c))
     if cfg.distribution == "rides" and res.paths.shape[0]:
         os.makedirs(os.path.dirname(OUTPUT_CSV), exist_ok=True)
         rides.save_heavy_hitters(res.paths, OUTPUT_CSV)
-        print(f"Wrote {res.paths.shape[0]} heavy hitters to {OUTPUT_CSV}")
+        obs.emit("csv.written", path=OUTPUT_CSV, hitters=int(res.paths.shape[0]))
 
 
 def main() -> None:
-    asyncio.run(amain())
+    # shared exit contract (obs.exit_report): SIGTERM -> SystemExit so the
+    # run report is still written — a timed-out run leaves per-level
+    # phase/byte accounting up to the level it died in (plus the
+    # heartbeat trail naming it).  The leader keeps the bare
+    # $FHH_RUN_REPORT path; the servers claim .s0/.s1 siblings.
+    with obs.exit_report():
+        asyncio.run(amain())
 
 
 if __name__ == "__main__":

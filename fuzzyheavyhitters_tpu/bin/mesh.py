@@ -37,6 +37,7 @@ import time
 
 import numpy as np
 
+from .. import obs
 from ..ops import ibdcf
 from ..parallel import mesh as meshmod
 from ..utils import config as configmod
@@ -75,16 +76,34 @@ def main() -> None:
         meshmod.init_distributed(
             args.coordinator, args.processes, args.process_id
         )
+        if args.processes > 1:
+            # both processes inherit the same $FHH_RUN_REPORT and write
+            # atomically at exit — the last exiter would clobber the other
+            # party's report; give each process its own .p<id> file
+            obs.claim_report_path(f"p{args.process_id}")
 
+    # shared exit contract (obs.exit_report): SIGTERM -> SystemExit so a
+    # timed-out mesh run still writes its report, with per-level
+    # accounting up to the level it died in
+    with obs.exit_report():
+        _run(cfg, args, jax)
+
+
+def _run(cfg, args, jax) -> None:
     rng = np.random.default_rng()
     n = args.num_requests
-    print(f"{cfg.distribution} distribution sampling...")
-    pts = sample_points(cfg, n, rng)
-    t0 = time.perf_counter()
-    k0, k1 = ibdcf.gen_l_inf_ball(
-        pts, cfg.ball_size, rng, engine=ibdcf.best_engine(),
+    reg = obs.default_registry()
+    obs.emit("sampling", distribution=cfg.distribution, n=n)
+    with reg.span("sampling"):
+        pts = sample_points(cfg, n, rng)
+    with reg.span("keygen"):
+        t0 = time.perf_counter()
+        k0, k1 = ibdcf.gen_l_inf_ball(
+            pts, cfg.ball_size, rng, engine=ibdcf.best_engine(),
+        )
+    obs.emit(
+        "keygen.report", seconds=round(time.perf_counter() - t0, 2), n_keys=n
     )
-    print(f"keygen: {time.perf_counter() - t0:.2f}s for {n} clients")
 
     mesh = meshmod.make_mesh(args.devices)
     if args.processes == 2:
@@ -98,14 +117,16 @@ def main() -> None:
         )
     t0 = time.perf_counter()
     res = meshmod.MeshLeader(runner).run(nreqs=n, threshold=cfg.threshold)
-    print(f"Crawl done in {time.perf_counter() - t0:.2f}s")
+    obs.emit("crawl.done", seconds=round(time.perf_counter() - t0, 2))
     for row, c in zip(res.decode_ints(), res.counts):
-        print(f"Final {row.tolist()} -> {int(c)}")
+        obs.emit("hitter", value=str(row.tolist()), count=int(c))
     if cfg.distribution == "rides" and res.paths.shape[0]:
         # identical CSV contract as the socket deployment (bin/leader.py)
         os.makedirs(os.path.dirname(OUTPUT_CSV), exist_ok=True)
         rides.save_heavy_hitters(res.paths, OUTPUT_CSV)
-        print(f"Wrote {res.paths.shape[0]} heavy hitters to {OUTPUT_CSV}")
+        obs.emit(
+            "csv.written", path=OUTPUT_CSV, hitters=int(res.paths.shape[0])
+        )
 
 
 if __name__ == "__main__":

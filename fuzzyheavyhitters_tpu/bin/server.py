@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 
+from .. import obs
 from ..protocol.rpc import CollectorServer
 from ..utils import config as configmod
 
@@ -23,14 +24,11 @@ def _split(addr: str) -> tuple[str, int]:
     return host, int(port)
 
 
-async def amain() -> None:
+async def amain(cfg, server_id: int) -> None:
     import contextlib
 
     import jax
 
-    cfg, server_id, _ = configmod.get_args("Server", get_server_id=True)
-    if server_id not in (0, 1):
-        raise SystemExit(f"server_id must be 0 or 1, got {server_id}")
     host0, port0 = _split(cfg.server0)
     host1, port1 = _split(cfg.server1)
     my_host, my_port = (host0, port0) if server_id == 0 else (host1, port1)
@@ -56,13 +54,27 @@ async def amain() -> None:
     with ctx:
         server = CollectorServer(server_id, cfg)
         srv = await server.start(my_host, my_port, peer_host, peer_port)
-        print(f"server {server_id} serving on {my_host}:{my_port}", flush=True)
+        obs.emit("server.serving", server=server_id, host=my_host, port=my_port)
         async with srv:
             await srv.serve_forever()
 
 
 def main() -> None:
-    asyncio.run(amain())
+    # arg validation runs BEFORE the exit-report contract: a server that
+    # dies here has no identity yet, and writing the run report to the
+    # bare shared $FHH_RUN_REPORT path would clobber the leader's
+    cfg, server_id, _ = configmod.get_args("Server", get_server_id=True)
+    if server_id not in (0, 1):
+        raise SystemExit(f"server_id must be 0 or 1, got {server_id}")
+    # both servers + the leader inherit ONE $FHH_RUN_REPORT from the shared
+    # environment; the leader keeps the bare path, each server claims a
+    # .s<id> sibling so the last exiter can't clobber the others' reports
+    obs.claim_report_path(f"s{server_id}")
+    # shared exit contract (obs.exit_report): SIGTERM -> SystemExit, so a
+    # drained/killed server still leaves its run report (phase seconds,
+    # data-plane bytes, fetch counts) + a heartbeat trail for the postmortem
+    with obs.exit_report():
+        asyncio.run(amain(cfg, server_id))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,60 @@
+"""Structured telemetry for the crawl stack — dependency-free.
+
+Four small pieces, one coherent layer (replacing the ad-hoc ``print``
+taxonomy that left BENCH_r05's rc=124 postmortem with nothing but an XLA
+platform warning):
+
+- :mod:`.metrics` — named counters, gauges, and phase timers with
+  level-indexed breakdowns, grouped into per-component ``Registry``
+  objects (each collector server owns one; the in-process driver, the
+  RPC leader, and the mesh leader own theirs) plus span-style timing
+  contexts that mark "what is running right now" for the heartbeat.
+- :mod:`.logs` — structured log emission: human-readable lines by
+  default, JSON-lines via ``FHH_LOG_FORMAT=json``; stream and severity
+  threshold are env/config knobs.
+- :mod:`.heartbeat` — a periodic daemon thread that logs every live
+  registry's active span (phase name, level, elapsed), so a wedged run
+  shows exactly which phase and level it died in.
+- :mod:`.report` — the end-of-run machine-readable report: per-level
+  phase seconds, data-plane bytes sent/received, device-fetch counts,
+  GC test counts, OT batch sizes, frontier/survivor sizes, checkpoint
+  events — everything the registries accumulated, as one JSON document.
+
+Env knobs (all optional):
+
+- ``FHH_LOG_FORMAT``: ``human`` (default) | ``json`` (JSON-lines)
+- ``FHH_LOG_STREAM``: ``stderr`` (default) | ``stdout`` | a file path
+- ``FHH_LOG_LEVEL``: ``debug`` | ``info`` (default) | ``warn`` | ``error``
+- ``FHH_HEARTBEAT_S``: heartbeat period in seconds (``0`` disables; the
+  binaries default to 30 s when unset)
+- ``FHH_RUN_REPORT``: path; when set, the binaries write the end-of-run
+  report there
+"""
+
+from .heartbeat import start_heartbeat, stop_heartbeat
+from .logs import configure as configure_logs, emit
+from .metrics import Registry, all_registries, default_registry
+from .report import (
+    claim_report_path,
+    exit_report,
+    maybe_write_run_report,
+    per_process_report_path,
+    run_report,
+    write_run_report,
+)
+
+__all__ = [
+    "Registry",
+    "all_registries",
+    "claim_report_path",
+    "configure_logs",
+    "default_registry",
+    "emit",
+    "exit_report",
+    "maybe_write_run_report",
+    "per_process_report_path",
+    "run_report",
+    "start_heartbeat",
+    "stop_heartbeat",
+    "write_run_report",
+]

@@ -1,0 +1,150 @@
+"""End-of-run machine-readable report.
+
+One JSON document aggregating every registry's snapshot — the artifact
+bench.py and postmortems consume instead of scraping stdout.  Schema
+(``fhh-run-report/1``)::
+
+    {
+      "schema": "fhh-run-report/1",
+      "written_at": <epoch seconds>,
+      "registries": {
+        "server0": {
+          "counters": {"data_bytes_sent": {"total": N, "by_level": {"0": n0, ...}}, ...},
+          "gauges":   {"survivors":       {"last": v, "by_level": {...}}, ...},
+          "phases":   {"fss": {"seconds": s, "count": c, "by_level": {...}}, ...}
+        },
+        ...
+      }
+    }
+
+Well-known metric names (what populates them):
+
+- phases ``fss`` / ``gc_ot`` / ``field`` — the reference's per-level
+  3-phase server taxonomy (protocol/rpc.py crawl verbs; trusted mode's
+  ``gc_ot`` slot is the plaintext exchange), plus ``level`` on the
+  leader/driver side and ``upload_keys`` / ``setup`` one-offs.
+- counters ``data_bytes_sent`` / ``data_bytes_recv`` /
+  ``data_msgs_sent`` — server↔server data plane, per level;
+  ``control_bytes_*`` — leader↔server control plane;
+  ``device_fetches`` — device->host transfers (the floor for
+  remote-chip tunnels: fetch COUNT, not byte count — now both are
+  measured); ``gc_tests`` — secure-mode equality tests;
+  ``checkpoint_writes`` / ``checkpoint_restores``.
+- gauges ``ot_batch_size`` (per level), ``survivors`` /
+  ``frontier_nodes`` (per level).
+
+``FHH_RUN_REPORT=<path>`` makes the binaries (and bench) write the
+report there at exit / on SIGTERM; :func:`maybe_write_run_report` is
+that one-liner.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import signal
+import time
+
+from . import metrics
+
+SCHEMA = "fhh-run-report/1"
+
+
+def run_report(registries=None) -> dict:
+    """Aggregate snapshot of ``registries`` (default: every live one,
+    plus the retained final snapshots of dropped ones — see
+    ``metrics._retain_final``; snapshots beyond the retention bound are
+    counted under ``dropped_registries`` so the cap is never silent).
+
+    Same-named registries (a second ``driver.Leader`` after a checkpoint
+    restore registers another ``driver``) get deterministic ``name#2``,
+    ``name#3``, ... keys in registration order instead of silently
+    overwriting each other."""
+    dropped = 0
+    if registries is None:
+        # dedupe by (name, seq), live snapshot winning: at interpreter
+        # exit the weakref finalizers (whose exitfunc registers at first
+        # Registry creation, AFTER e.g. bench's atexit dump) may have
+        # already retained final snapshots of registries that are still
+        # alive — without the dedupe every one would appear twice
+        by_id = {
+            (name, seq): (name, seq, snap)
+            for name, seq, snap in metrics.final_snapshots()
+        }
+        for r in metrics.all_registries():
+            by_id[(r.name, r.seq)] = (r.name, r.seq, r.report())
+        items = sorted(by_id.values(), key=lambda t: (t[0], t[1]))
+        dropped = metrics.final_dropped()
+    else:
+        items = [(r.name, r.seq, r.report()) for r in registries]
+    out: dict = {}
+    seen: dict = {}
+    for name, _seq, snap in items:
+        n = seen[name] = seen.get(name, 0) + 1
+        out[name if n == 1 else f"{name}#{n}"] = snap
+    doc = {
+        "schema": SCHEMA,
+        "written_at": round(time.time(), 3),
+        "registries": out,
+    }
+    if dropped:
+        doc["dropped_registries"] = dropped
+    return doc
+
+
+def write_run_report(path: str, registries=None) -> dict:
+    rep = run_report(registries)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(rep, f, indent=1)
+    os.replace(tmp, path)  # atomic: a SIGKILL mid-write leaves no torn file
+    return rep
+
+
+def maybe_write_run_report(registries=None) -> str | None:
+    """Write to ``$FHH_RUN_REPORT`` if set; returns the path written."""
+    path = os.environ.get("FHH_RUN_REPORT")
+    if not path:
+        return None
+    write_run_report(path, registries)
+    return path
+
+
+def per_process_report_path(path: str, tag: str) -> str:
+    """``/tmp/r.json`` + ``s0`` -> ``/tmp/r.s0.json``.  Multi-process
+    deployments (socket servers, 2-process mesh) inherit ONE
+    ``FHH_RUN_REPORT`` path from the shared environment, and each process
+    writes the whole document atomically at exit — without a per-process
+    suffix the last exiter silently clobbers the other parties' reports."""
+    root, ext = os.path.splitext(path)
+    return f"{root}.{tag}{ext}"
+
+
+def claim_report_path(tag: str) -> None:
+    """Rewrite this process's ``$FHH_RUN_REPORT`` to its per-process
+    path (no-op when the env var is unset)."""
+    path = os.environ.get("FHH_RUN_REPORT")
+    if path:
+        os.environ["FHH_RUN_REPORT"] = per_process_report_path(path, tag)
+
+
+def _sigterm(_sig, _frame):
+    raise SystemExit(143)
+
+
+@contextlib.contextmanager
+def exit_report(heartbeat_default_s: float = 30.0):
+    """The binaries' shared exit contract: SIGTERM -> ``SystemExit(143)``
+    (so the ``finally`` runs instead of the default immediate kill),
+    heartbeat on, and the run report written on the way out — a
+    timed-out/killed run still leaves the per-level accounting it
+    accumulated plus a heartbeat trail naming the phase it died in."""
+    from .heartbeat import start_heartbeat
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    start_heartbeat(heartbeat_default_s)
+    try:
+        yield
+    finally:
+        maybe_write_run_report()

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import metrics as obsmetrics
 from ..ops import baseot, gc, otext, prg
 from ..ops.fields import F255, FE62
 from ..ops.ibdcf import IbDcfKeyBatch
@@ -581,6 +582,9 @@ class MeshLeader:
         self.min_bucket = runner.min_bucket if min_bucket is None else min_bucket
         self.paths = None
         self.n_nodes = 0
+        # telemetry: level spans (the heartbeat names the level a wedged
+        # pod crawl died in) + survivor gauges + device-fetch counts
+        self.obs = obsmetrics.Registry("mesh")
 
     def _level_counts(self, level: int) -> np.ndarray:
         """Per-level counts: plaintext compare in trusted mode, or leader
@@ -588,6 +592,8 @@ class MeshLeader:
         (FE62 inner levels, F255 last — ref: rpc.rs:60-62)."""
         r = self.r
         last = level == r.data_len - 1
+        self.obs.count("device_fetches", level=level)  # one host fetch per
+        # level: the counts (trusted) or the reconstructed share diff
         if not r.secure:
             return r.level_counts(level, last=last)
         if last:
@@ -614,26 +620,30 @@ class MeshLeader:
         self.n_nodes = 1
         counts_kept = np.zeros(0, np.uint32)
         for level in range(r.data_len):
-            counts = self._level_counts(level)
-            thresh = max(1, int(threshold * nreqs))
-            keep = counts >= thresh
-            keep[self.n_nodes :, :] = False
-            parent, pattern, n_alive = collect.compact_survivors(
-                keep, r.f_max, self.min_bucket
-            )
-            pat_bits = collect.pattern_to_bits(pattern, d)
-            if n_alive == 0:
-                return CrawlResult(
-                    paths=np.zeros((0, d, level + 1), bool),
-                    counts=np.zeros(0, np.uint32),
+            with self.obs.span("level", level=level):
+                counts = self._level_counts(level)
+                thresh = max(1, int(threshold * nreqs))
+                keep = counts >= thresh
+                keep[self.n_nodes :, :] = False
+                parent, pattern, n_alive = collect.compact_survivors(
+                    keep, r.f_max, self.min_bucket
                 )
-            if level < r.data_len - 1:  # nothing advances past the leaves
-                r.advance(level, parent, pat_bits, n_alive)
-            new_paths = np.zeros((n_alive, d, self.paths.shape[-1] + 1), bool)
-            for i in range(n_alive):
-                new_paths[i, :, :-1] = self.paths[parent[i]]
-                new_paths[i, :, -1] = pat_bits[i]
-            self.paths = new_paths
-            self.n_nodes = n_alive
-            counts_kept = counts[parent[:n_alive], pattern[:n_alive]]
+                pat_bits = collect.pattern_to_bits(pattern, d)
+                self.obs.gauge("survivors", n_alive, level=level)
+                if n_alive == 0:
+                    return CrawlResult(
+                        paths=np.zeros((0, d, level + 1), bool),
+                        counts=np.zeros(0, np.uint32),
+                    )
+                if level < r.data_len - 1:  # nothing advances past the leaves
+                    r.advance(level, parent, pat_bits, n_alive)
+                new_paths = np.zeros(
+                    (n_alive, d, self.paths.shape[-1] + 1), bool
+                )
+                for i in range(n_alive):
+                    new_paths[i, :, :-1] = self.paths[parent[i]]
+                    new_paths[i, :, -1] = pat_bits[i]
+                self.paths = new_paths
+                self.n_nodes = n_alive
+                counts_kept = counts[parent[:n_alive], pattern[:n_alive]]
         return CrawlResult(paths=self.paths, counts=counts_kept)

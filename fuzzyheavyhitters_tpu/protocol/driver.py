@@ -27,6 +27,8 @@ import jax
 import numpy as np
 from jax import lax
 
+from .. import obs as obsmod
+from ..obs import metrics as obsmetrics
 from ..ops.ibdcf import EvalState, IbDcfKeyBatch
 from . import collect
 
@@ -140,6 +142,13 @@ class Leader:
     # leader-side bookkeeping
     paths: np.ndarray = field(default=None)  # bool[F, d, level]
     n_nodes: int = 0
+    # telemetry: per-level phase timers + survivor gauges + checkpoint
+    # events; the heartbeat thread names the level a wedged crawl died in
+    obs: obsmetrics.Registry = None
+
+    def __post_init__(self):
+        if self.obs is None:
+            self.obs = obsmetrics.Registry("driver")
 
     def tree_init(self):
         for s in (self.server0, self.server1):
@@ -179,72 +188,78 @@ class Leader:
         """
         d = self.n_dims
         masks = collect.pattern_masks(d)
-        if self.stream:
-            cw0 = self._take_cw(0, level)
-            cw1 = self._take_cw(1, level)
-            p0, _ = collect.expand_share_bits_from_cw(
-                cw0, self.server0.frontier, want_children=False
-            )
-            p1, _ = collect.expand_share_bits_from_cw(
-                cw1, self.server1.frontier, want_children=False
-            )
-        else:
-            p0, ch0 = collect.expand_share_bits(
-                self.server0.keys, self.server0.frontier, level
-            )
-            p1, ch1 = collect.expand_share_bits(
-                self.server1.keys, self.server1.frontier, level
-            )
-            self.server0.children, self.server1.children = ch0, ch1
-        counts = collect.counts_by_pattern(
-            p0,
-            p1,
-            masks,
-            np.asarray(self.server0.alive_keys),
-            self.server0.frontier.alive,
-        )
-        counts = np.asarray(counts)  # [F, 2^d]
-
-        thresh = max(1, int(threshold * nreqs))  # ref: leader.rs:193-194
-        keep = counts >= thresh  # [F, 2^d]
-        keep[self.n_nodes :, :] = False
-        parent, pattern, n_alive = collect.compact_survivors(
-            keep, self.f_max, self.min_bucket
-        )
-        pat_bits = collect.pattern_to_bits(pattern, d)
-
-        if self.stream:
-            del p0, p1  # frontier buffers are donated by advance_from_cw
-            if level < self.data_len - 1 and n_alive:
-                f0, f1 = self.server0.frontier, self.server1.frontier
-                self.server0.frontier = None  # drop refs before donation
-                self.server1.frontier = None
-                self.server0.frontier = collect.advance_from_cw(
-                    cw0, f0, parent, pat_bits, n_alive, self.stream_chunk
+        with self.obs.span("level", level=level):
+            with self.obs.span("fss", level=level):
+                if self.stream:
+                    cw0 = self._take_cw(0, level)
+                    cw1 = self._take_cw(1, level)
+                    p0, _ = collect.expand_share_bits_from_cw(
+                        cw0, self.server0.frontier, want_children=False
+                    )
+                    p1, _ = collect.expand_share_bits_from_cw(
+                        cw1, self.server1.frontier, want_children=False
+                    )
+                else:
+                    p0, ch0 = collect.expand_share_bits(
+                        self.server0.keys, self.server0.frontier, level
+                    )
+                    p1, ch1 = collect.expand_share_bits(
+                        self.server1.keys, self.server1.frontier, level
+                    )
+                    self.server0.children, self.server1.children = ch0, ch1
+            with self.obs.span("field", level=level):
+                counts = collect.counts_by_pattern(
+                    p0,
+                    p1,
+                    masks,
+                    np.asarray(self.server0.alive_keys),
+                    self.server0.frontier.alive,
                 )
-                # free server 0's old frontier BEFORE server 1 advances:
-                # keeping both olds + both news alive is what overflows
-                # HBM at wide-frontier levels (four full frontiers)
-                del f0
-                self.server1.frontier = collect.advance_from_cw(
-                    cw1, f1, parent, pat_bits, n_alive, self.stream_chunk
-                )
-                del f1
-        else:
-            for s in (self.server0, self.server1):
-                s.frontier = collect.advance_from_children(
-                    s.children, parent, pat_bits, n_alive
-                )
-                s.children = None
+                self.obs.count("device_fetches")
+                counts = np.asarray(counts)  # [F, 2^d]
 
-        # leader-side path bookkeeping (child bit j = (pattern >> j) & 1)
-        new_paths = np.zeros((n_alive, d, self.paths.shape[-1] + 1), bool)
-        for i in range(n_alive):
-            new_paths[i, :, :-1] = self.paths[parent[i]]
-            new_paths[i, :, -1] = pat_bits[i]
-        self.paths = new_paths
-        self.n_nodes = n_alive
-        self._last_counts = counts[parent[:n_alive], pattern[:n_alive]]
+                thresh = max(1, int(threshold * nreqs))  # ref: leader.rs:193-194
+                keep = counts >= thresh  # [F, 2^d]
+                keep[self.n_nodes :, :] = False
+                parent, pattern, n_alive = collect.compact_survivors(
+                    keep, self.f_max, self.min_bucket
+                )
+                pat_bits = collect.pattern_to_bits(pattern, d)
+
+            with self.obs.span("advance", level=level):
+                if self.stream:
+                    del p0, p1  # frontier buffers are donated by advance_from_cw
+                    if level < self.data_len - 1 and n_alive:
+                        f0, f1 = self.server0.frontier, self.server1.frontier
+                        self.server0.frontier = None  # drop refs before donation
+                        self.server1.frontier = None
+                        self.server0.frontier = collect.advance_from_cw(
+                            cw0, f0, parent, pat_bits, n_alive, self.stream_chunk
+                        )
+                        # free server 0's old frontier BEFORE server 1 advances:
+                        # keeping both olds + both news alive is what overflows
+                        # HBM at wide-frontier levels (four full frontiers)
+                        del f0
+                        self.server1.frontier = collect.advance_from_cw(
+                            cw1, f1, parent, pat_bits, n_alive, self.stream_chunk
+                        )
+                        del f1
+                else:
+                    for s in (self.server0, self.server1):
+                        s.frontier = collect.advance_from_children(
+                            s.children, parent, pat_bits, n_alive
+                        )
+                        s.children = None
+
+            # leader-side path bookkeeping (child bit j = (pattern >> j) & 1)
+            new_paths = np.zeros((n_alive, d, self.paths.shape[-1] + 1), bool)
+            for i in range(n_alive):
+                new_paths[i, :, :-1] = self.paths[parent[i]]
+                new_paths[i, :, -1] = pat_bits[i]
+            self.paths = new_paths
+            self.n_nodes = n_alive
+            self.obs.gauge("survivors", n_alive, level=level)
+            self._last_counts = counts[parent[:n_alive], pattern[:n_alive]]
         return n_alive
 
     def run(
@@ -306,18 +321,55 @@ class Leader:
     # -- checkpoint / resume -------------------------------------------------
 
     def _key_fingerprint(self) -> np.ndarray:
-        """SHA-256 over both servers' key identities (key_idx + root
-        seeds): a checkpoint resumed against DIFFERENT key batches would
-        evaluate one crawl's frontier states under another crawl's keys
-        and return silently wrong counts — turn that into a hard error.
-        Cached: keys are immutable for the crawl's lifetime, and the
-        device->host fetch behind the hash is tunnel-priced."""
+        """SHA-256 over both servers' key identities: key_idx + root seeds
+        PLUS an every-client checksum of the correction-word planes across
+        ALL levels.  Root seeds alone are not an identity — two keygen runs
+        sharing an RNG seed but differing in ball radius (or any other
+        keygen parameter) produce identical roots with different
+        correction words — and the level axis must be complete: the
+        radius perturbs the LOW bits of the interval endpoints, so the
+        first differing cw sits at the deepest levels, not level 0
+        (measured: ball 1 vs 2 at L=5 diverges only from level 3 down).
+        The client axis must be complete too — ANY client sample (prefix
+        or spread) admits two batches that diverge only at unsampled
+        clients — so each cw plane is collapsed by a position-weighted
+        mod-2^32 checksum over the client axis BEFORE the fetch: every
+        client contributes (odd weights are invertible mod 2^32, so a
+        change in any single client's plane always moves the sum), while
+        the device->host transfer stays the reduced plane (~16 KB at
+        L=512 vs ~2 MB per-client — tunnel-priced either way).  Cached:
+        keys are immutable for the crawl's lifetime."""
         fp = getattr(self, "_key_fp", None)
         if fp is None:
+            import jax.numpy as jnp
+
             h = hashlib.sha256()
             for s in (self.server0, self.server1):
-                h.update(np.ascontiguousarray(np.asarray(s.keys.key_idx)))
+                key_idx = np.asarray(s.keys.key_idx)
+                h.update(np.ascontiguousarray(key_idx))
                 h.update(np.ascontiguousarray(np.asarray(s.keys.root_seed)))
+                n = key_idx.shape[0]
+                # all-level cw planes: seeds [N, d, 2, L, 4] plus the t/y
+                # bit planes [N, d, 2, L, 2] (a divergence at any level
+                # lands in at least one); reduce with the array's own
+                # backend — streaming mode holds host keys, uploading
+                # them just to reduce would defeat the point — and in
+                # client CHUNKS: at the flagship 196k x L=512 shape a
+                # full-batch weighted product would transiently double
+                # the ~3 GB plane in host RAM (or HBM, which the crawl
+                # already runs near the limit of) at checkpoint time
+                for plane in (s.keys.cw_seed, s.keys.cw_bits, s.keys.cw_y_bits):
+                    xp = jnp if isinstance(plane, jax.Array) else np
+                    red = None
+                    for i in range(0, n, 4096):
+                        p = xp.asarray(plane[i : i + 4096], dtype=xp.uint32)
+                        w = (
+                            xp.arange(i, i + p.shape[0], dtype=xp.uint32) * 2
+                            + 1
+                        ).reshape((p.shape[0],) + (1,) * (p.ndim - 1))
+                        part = (p * w).sum(axis=0, dtype=xp.uint32)
+                        red = part if red is None else red + part
+                    h.update(np.ascontiguousarray(np.asarray(red)))
             fp = self._key_fp = np.frombuffer(h.digest(), np.uint8)
         return fp
 
@@ -360,6 +412,8 @@ class Leader:
         with open(tmp, "wb") as f:
             np.savez(f, **blob)
         os.replace(tmp, path)
+        self.obs.count("checkpoint_writes", level=level)
+        obsmod.emit("checkpoint.write", path=path, level=level)
 
     def restore(
         self, path: str,
@@ -369,7 +423,12 @@ class Leader:
         Refuses a checkpoint whose shape, key fingerprint, or (when both
         sides recorded them) crawl parameters differ from this Leader's —
         every mismatch would otherwise produce silently wrong hitters."""
-        z = np.load(path)
+        # materialize inside the context manager: NpzFile holds the file
+        # descriptor open until closed, and run() later os.remove()s this
+        # same path — a leaked handle pins the deleted file's blocks (and
+        # on some filesystems fails the remove outright)
+        with np.load(path) as npz:
+            z = {k: npz[k] for k in npz.files}
         meta = z["meta"]
         want = [self.n_dims, self.data_len, self.f_max, self.min_bucket]
         if list(meta) != want:
@@ -412,6 +471,8 @@ class Leader:
         self._last_counts = z["last_counts"]
         self._win = {}
         self._win_next = {}
+        self.obs.count("checkpoint_restores", level=int(z["level"]))
+        obsmod.emit("checkpoint.restore", path=path, level=int(z["level"]))
         return int(z["level"]) + 1
 
 

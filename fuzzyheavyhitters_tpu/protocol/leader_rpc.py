@@ -14,6 +14,8 @@ import asyncio
 import jax
 import numpy as np
 
+from .. import obs as obsmod
+from ..obs import metrics as obsmetrics
 from ..ops.fields import F255, FE62
 from ..ops.ibdcf import IbDcfKeyBatch
 from ..utils.config import Config
@@ -40,6 +42,13 @@ class RpcLeader:
         self.paths: np.ndarray | None = None
         self.n_nodes = 0
         self.has_sketch = False
+        # leader-side telemetry: level spans (the heartbeat names the
+        # level a wedged crawl died in) + survivor gauges
+        self.obs = obsmetrics.Registry("leader")
+        # the clients predate this registry (connect() runs first); rebind
+        # their control-plane byte accounting so control_bytes_* land on
+        # the leader's registry, not the process default
+        client0.obs = client1.obs = self.obs
 
     async def _both(self, verb: str, req=None):
         return await asyncio.gather(self.c0.call(verb, req), self.c1.call(verb, req))
@@ -78,12 +87,87 @@ class RpcLeader:
                     {"keys": _key_chunk(keys, sl), "sketch": sk_chunk(sketch, sl)},
                 )
 
-        tasks = []
-        for lo in range(0, n, bs):
-            sl = slice(lo, min(lo + bs, n))
-            tasks.append(send_one(self.c0, keys0, sketch0, sl))
-            tasks.append(send_one(self.c1, keys1, sketch1, sl))
-        await asyncio.gather(*tasks)
+        with self.obs.span("upload_keys"):
+            tasks = []
+            for lo in range(0, n, bs):
+                sl = slice(lo, min(lo + bs, n))
+                tasks.append(send_one(self.c0, keys0, sketch0, sl))
+                tasks.append(send_one(self.c1, keys1, sketch1, sl))
+            await asyncio.gather(*tasks)
+        self.obs.count("keys_uploaded", n)
+
+    async def _run_one_level(self, level: int, nreqs: int, thresh: int):
+        """One crawl->reconstruct->threshold->prune round under a level
+        span (the heartbeat names this level while it runs).  Returns
+        ``(counts_kept, alive_after_verify)`` with ``counts_kept`` None
+        when the crawl died out at this level."""
+        cfg = self.cfg
+        d, L = cfg.n_dims, cfg.data_len
+        last = level == L - 1
+        alive_after_verify = None
+        if self.has_sketch and level != 1:
+            # malicious-security gate first, so failing clients'
+            # liveness flags flip before this level's counts are
+            # taken.  Level 0 runs the FULL depth-1 check (both root
+            # children per dim) — the first threshold never sees
+            # unverified counts; levels >= 2 verify the
+            # frontier-following shares stored by the previous prune.
+            # The depth-1 frontier re-verify (level 1) is skipped: its
+            # triples were consumed by the level-0 full check (see
+            # rpc.sketch_verify / sketch.py scope note).
+            a0, _ = await self._both("sketch_verify", {"level": level})
+            alive_after_verify = np.asarray(a0)
+        verb = "tree_crawl_last" if last else "tree_crawl"
+        # alternate the garbling server per level (the reference's
+        # gc_sender flip, leader.rs:204-210) to split garbling cost
+        s0, s1 = await self._both(
+            verb, {"level": level, "garbler": level % 2}
+        )
+        if last:
+            v = np.asarray(F255.sub(s0, s1))  # leader-side reconstruct
+            counts = v[..., 0].astype(np.uint32)  # counts < 2^32 by def
+            if np.any(v[..., 1:]):  # boundary check: must survive -O
+                raise RuntimeError("non-count residue in F255 share")
+        else:
+            v = np.asarray(FE62.canon(FE62.sub(s0, s1)))
+            if np.any(v > nreqs):  # e.g. a share-sign/role mismatch
+                raise RuntimeError("count reconstruction out of range")
+            counts = v.astype(np.uint32)
+        keep = counts >= thresh
+        keep[self.n_nodes :, :] = False
+        parent, pattern, n_alive = collect.compact_survivors(
+            keep, cfg.f_max, self.min_bucket
+        )
+        pat_bits = collect.pattern_to_bits(pattern, d)
+        self.obs.gauge("survivors", n_alive, level=level)
+        if n_alive == 0:
+            return None, alive_after_verify
+        if last:
+            await self._both(
+                "tree_prune_last",
+                {
+                    "parent_idx": parent,
+                    "pattern_bits": pat_bits,
+                    "n_alive": n_alive,
+                },
+            )
+        else:
+            await self._both(
+                "tree_prune",
+                {
+                    "level": level,
+                    "parent_idx": parent,
+                    "pattern_bits": pat_bits,
+                    "n_alive": n_alive,
+                },
+            )
+        new_paths = np.zeros((n_alive, d, self.paths.shape[-1] + 1), bool)
+        for i in range(n_alive):
+            new_paths[i, :, :-1] = self.paths[parent[i]]
+            new_paths[i, :, -1] = pat_bits[i]
+        self.paths = new_paths
+        self.n_nodes = n_alive
+        return counts[parent[:n_alive], pattern[:n_alive]], alive_after_verify
 
     async def run(self, nreqs: int) -> CrawlResult:
         cfg = self.cfg
@@ -95,72 +179,17 @@ class RpcLeader:
         counts_kept = np.zeros(0, np.uint32)
         alive_before_leaf = None  # liveness after the latest verify
         for level in range(L):
-            last = level == L - 1
-            if self.has_sketch and level != 1:
-                # malicious-security gate first, so failing clients'
-                # liveness flags flip before this level's counts are
-                # taken.  Level 0 runs the FULL depth-1 check (both root
-                # children per dim) — the first threshold never sees
-                # unverified counts; levels >= 2 verify the
-                # frontier-following shares stored by the previous prune.
-                # The depth-1 frontier re-verify (level 1) is skipped: its
-                # triples were consumed by the level-0 full check (see
-                # rpc.sketch_verify / sketch.py scope note).
-                a0, _ = await self._both("sketch_verify", {"level": level})
-                alive_before_leaf = np.asarray(a0)
-            verb = "tree_crawl_last" if last else "tree_crawl"
-            # alternate the garbling server per level (the reference's
-            # gc_sender flip, leader.rs:204-210) to split garbling cost
-            s0, s1 = await self._both(
-                verb, {"level": level, "garbler": level % 2}
-            )
-            if last:
-                v = np.asarray(F255.sub(s0, s1))  # leader-side reconstruct
-                counts = v[..., 0].astype(np.uint32)  # counts < 2^32 by def
-                if np.any(v[..., 1:]):  # boundary check: must survive -O
-                    raise RuntimeError("non-count residue in F255 share")
-            else:
-                v = np.asarray(FE62.canon(FE62.sub(s0, s1)))
-                if np.any(v > nreqs):  # e.g. a share-sign/role mismatch
-                    raise RuntimeError("count reconstruction out of range")
-                counts = v.astype(np.uint32)
-            keep = counts >= thresh
-            keep[self.n_nodes :, :] = False
-            parent, pattern, n_alive = collect.compact_survivors(
-                keep, cfg.f_max, self.min_bucket
-            )
-            pat_bits = collect.pattern_to_bits(pattern, d)
-            if n_alive == 0:
+            with self.obs.span("level", level=level):
+                counts_kept, alive = await self._run_one_level(
+                    level, nreqs, thresh
+                )
+            if alive is not None:
+                alive_before_leaf = alive
+            if counts_kept is None:
                 return CrawlResult(
                     paths=np.zeros((0, d, level + 1), bool),
                     counts=np.zeros(0, np.uint32),
                 )
-            if last:
-                await self._both(
-                    "tree_prune_last",
-                    {
-                        "parent_idx": parent,
-                        "pattern_bits": pat_bits,
-                        "n_alive": n_alive,
-                    },
-                )
-            else:
-                await self._both(
-                    "tree_prune",
-                    {
-                        "level": level,
-                        "parent_idx": parent,
-                        "pattern_bits": pat_bits,
-                        "n_alive": n_alive,
-                    },
-                )
-            new_paths = np.zeros((n_alive, d, self.paths.shape[-1] + 1), bool)
-            for i in range(n_alive):
-                new_paths[i, :, :-1] = self.paths[parent[i]]
-                new_paths[i, :, -1] = pat_bits[i]
-            self.paths = new_paths
-            self.n_nodes = n_alive
-            counts_kept = counts[parent[:n_alive], pattern[:n_alive]]
         if self.has_sketch and L > 1:
             # final F255 leaf-payload check (surviving leaves; counts for
             # this collection are already taken — the verdict gates the
@@ -178,7 +207,11 @@ class RpcLeader:
                 else np.ones_like(np.asarray(a0))
             )
             if np.any(prev & ~np.asarray(a0)):
-                print("WARNING: forged sketch leaf payload detected")
+                obsmod.emit(
+                    "sketch.leaf_forgery",
+                    severity="warn",
+                    new_exclusions=int(np.sum(prev & ~np.asarray(a0))),
+                )
         # final reconstruction from re-served leaf shares: v0 - v1 per
         # surviving leaf (ref: collect.rs:993-1029 final_shares/final_values;
         # the crawl-time counts are only the pruning signal)

@@ -44,6 +44,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
+from ..obs import metrics as obsmetrics
 from ..ops import baseot, dpf, gc, ibdcf, otext, prg
 from ..ops.fields import F255, FE62
 from ..ops.ibdcf import IbDcfKeyBatch
@@ -66,25 +68,40 @@ _SKETCH_TREEDEF = sketchmod.SketchKeyBatch(
 )
 
 
-async def _send(writer: asyncio.StreamWriter, obj) -> None:
+async def _send(writer: asyncio.StreamWriter, obj, count=None) -> None:
+    """``count``, when given, is called with the framed byte size — the
+    data-plane accounting hook (obs counters)."""
     data = pickle.dumps(obj, protocol=5)
+    if count is not None:
+        count(len(data) + _HDR.size)
     writer.write(_HDR.pack(len(data)) + data)
     await writer.drain()
 
 
-async def _recv(reader: asyncio.StreamReader):
+async def _recv(reader: asyncio.StreamReader, count=None):
     hdr = await reader.readexactly(_HDR.size)
     (n,) = _HDR.unpack(hdr)
+    if count is not None:
+        count(n + _HDR.size)
     return pickle.loads(await reader.readexactly(n))
 
 
-async def _fetch(x) -> np.ndarray:
+async def _fetch(
+    x, reg: obsmetrics.Registry | None = None, level: int | None = None
+) -> np.ndarray:
     """Device->host fetch OFF the event loop.  A bare ``np.asarray`` on a
     device array blocks the whole loop for a full transfer (a ~110 ms RTT
     on remote-chip tunnels) — serializing the two servers' fetches when
     they share a process (the in-process bench/tests) and starving
     keepalives/concurrent verbs in any deployment.  np.asarray of distinct
-    arrays is thread-safe in JAX; the GIL releases during the copy."""
+    arrays is thread-safe in JAX; the GIL releases during the copy.
+
+    ``reg`` counts the fetch: on remote-chip tunnels fetch COUNT, not byte
+    count, is the latency floor (each is a full round trip), so the run
+    report carries both.  ``level`` attributes the fetch when the call
+    site sits outside any span (span-active callers inherit)."""
+    if reg is not None:
+        reg.count("device_fetches", level=level)
     return await asyncio.to_thread(np.asarray, x)
 
 
@@ -142,11 +159,18 @@ class CollectorServer:
     _sketch_pairs: tuple | None = None  # (pair shares [F, N, d, lanes], depth)
     _sketch_pairs_field: object | None = None
     _sketch_seed: np.ndarray | None = None  # coin-flipped challenge seed
-    _gc_tests: int = 0  # secure-mode equality tests run since reset
-    # accumulated [fss, gc_ot, field] phase seconds since reset (the
-    # reference's 3-phase level taxonomy, collect.rs:412-503)
-    _phase_seconds: list = field(default_factory=lambda: [0.0, 0.0, 0.0])
+    # telemetry: phase timers (the reference's 3-phase level taxonomy,
+    # collect.rs:412-503, as "fss"/"gc_ot"/"field"), data-plane byte and
+    # device-fetch accounting, gc_tests — all per level (obs/report.py
+    # names the full schema).  One registry PER server: the bench and the
+    # tests run both servers in one process and the run report asserts
+    # their accounting consistent against each other.
+    obs: obsmetrics.Registry | None = None
     _verb_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+
+    def __post_init__(self):
+        if self.obs is None:
+            self.obs = obsmetrics.Registry(f"server{self.server_id}")
 
     # -- verbs (ref: rpc.rs:56-66) ---------------------------------------
 
@@ -164,8 +188,7 @@ class CollectorServer:
         self._sketch_depth = 0
         self._sketch_pairs = None
         self._sketch_pairs_field = None
-        self._gc_tests = 0
-        self._phase_seconds = [0.0, 0.0, 0.0]
+        self.obs.reset()  # fresh per-collection phase/byte/fetch accounting
         if self._ot is not None:  # fresh GC/b2a randomness per collection
             self._sec_seed = np.frombuffer(
                 _secrets.token_bytes(16), dtype="<u4"
@@ -382,40 +405,67 @@ class CollectorServer:
         self._sketch_pairs = (pair, level + 1)
         self._sketch_pairs_field = fld
 
+    # data-plane framing with byte/message accounting; levels attribute
+    # via the active span (obs.metrics.Registry.count)
+    async def _dp_send(self, obj):
+        self.obs.count("data_msgs_sent")
+        await _send(
+            self._peer_writer, obj,
+            count=lambda n: self.obs.count("data_bytes_sent", n),
+        )
+
+    async def _dp_recv(self):
+        return await _recv(
+            self._peer_reader,
+            count=lambda n: self.obs.count("data_bytes_recv", n),
+        )
+
     async def _swap(self, obj):
         """Role-ordered data-plane exchange: server 0 writes first, server 1
         reads first — symmetric send-then-recv deadlocks once payloads
         exceed the combined socket buffers (both drains stall)."""
         if self.server_id == 0:
-            await _send(self._peer_writer, obj)
-            return await _recv(self._peer_reader)
-        peer = await _recv(self._peer_reader)
-        await _send(self._peer_writer, obj)
+            await self._dp_send(obj)
+            return await self._dp_recv()
+        peer = await self._dp_recv()
+        await self._dp_send(obj)
         return peer
 
+    def _emit_level_phases(self, level: int, fss, gc_ot, field) -> None:
+        """Per-level phase line (the successor of the old three prints):
+        structured, severity=debug so a 512-level crawl doesn't spam the
+        console, totals always available in the run report.  Takes the
+        three exited spans — their ``seconds`` is THIS pass's duration,
+        where the registry total would inflate on a re-crawled level."""
+        obs.emit(
+            "level.phases",
+            severity="debug",
+            server=self.server_id,
+            level=level,
+            fss_s=fss.seconds,
+            gc_ot_s=gc_ot.seconds,
+            field_s=field.seconds,
+        )
+
     async def _crawl_counts(self, level: int, last: bool = False) -> np.ndarray:
-        t0 = time.perf_counter()
-        packed, self._children = collect.expand_share_bits(
-            self.keys, self.frontier, level, want_children=not last
-        )
-        packed_np = await _fetch(packed)  # forces the device work to finish
-        t1 = time.perf_counter()
-        # data plane: swap packed share bits with the peer server
-        peer = await self._swap(packed_np)
-        t2 = time.perf_counter()
-        masks = collect.pattern_masks(self.keys.cw_seed.shape[1])
-        counts = collect.counts_by_pattern(
-            packed, peer, masks, self.alive_keys, self.frontier.alive
-        )
-        counts = await _fetch(counts)
-        t3 = time.perf_counter()
         # per-level phase taxonomy of the reference (collect.rs:412-503);
         # trusted mode's "GC and OT" slot is the plaintext exchange
-        for i, dt in enumerate((t1 - t0, t2 - t1, t3 - t2)):
-            self._phase_seconds[i] += dt
-        print(f"Tree searching and FSS - {t1 - t0:.4f}s")
-        print(f"Garbled Circuit and OT - {t2 - t1:.4f}s")
-        print(f"Field actions - {t3 - t2:.4f}s")
+        with self.obs.span("fss", level=level) as sp_fss:
+            packed, self._children = collect.expand_share_bits(
+                self.keys, self.frontier, level, want_children=not last
+            )
+            # forces the device work to finish
+            packed_np = await _fetch(packed, self.obs)
+        with self.obs.span("gc_ot", level=level) as sp_gc:
+            # data plane: swap packed share bits with the peer server
+            peer = await self._swap(packed_np)
+        with self.obs.span("field", level=level) as sp_field:
+            masks = collect.pattern_masks(self.keys.cw_seed.shape[1])
+            counts = collect.counts_by_pattern(
+                packed, peer, masks, self.alive_keys, self.frontier.alive
+            )
+            counts = await _fetch(counts, self.obs)
+        self._emit_level_phases(level, sp_fss, sp_gc, sp_field)
         return counts
 
     async def _crawl_counts_secure(
@@ -439,63 +489,61 @@ class CollectorServer:
         the garbled batch under the OUTPUT wire labels
         (secure.gb_step_fused).  (The reference runs GC then a separate
         OT round here, collect.rs:419-482.)"""
-        t0 = time.perf_counter()
-        packed, self._children = collect.expand_share_bits(
-            self.keys, self.frontier, level, want_children=not last
-        )
-        d = self.keys.cw_seed.shape[1]
-        C, S = 1 << d, 2 * d
-        strs = secure.child_strings(packed, d)  # [F, C, N, S]
-        F_, _, N, _ = strs.shape
-        B = F_ * C * N
-        self._gc_tests += B
-        flat = strs.reshape(B, S)
-        t1 = time.perf_counter()  # dispatch time only: the FSS expansion
-        # itself overlaps the exchange below (no sync — a
-        # block_until_ready here would cost a tunnel RTT)
-        w = secure.alive_weight(self.frontier.alive, self.alive_keys, C)
-        # crawl counter makes every garbling's randomness unique even if a
-        # leader re-crawls a level without reset (seed reuse with a fixed
-        # R = s would leak cross-run equality deltas to the evaluator)
-        self._crawl_ctr += 1
-        gc_seed = secure.derive_seed(self._sec_seed, 1, level, self._crawl_ctr)
-        b2a_seed = secure.derive_seed(self._sec_seed, 2, level, self._crawl_ctr)
-        ot4 = secure._ot4_use(S)  # S == 2: 1-of-4 OT, no garbled circuit
-        if self.server_id == garbler:  # garbler/sender + OT-extension sender
-            u = await _recv(self._peer_reader)
-            if ot4:
-                msg, vals = secure.gb_step_ot4(
-                    self._ot_snd, u, flat, b2a_seed, count_field, garbler
-                )
-            else:
-                msg, vals = secure.gb_step_fused(
-                    self._ot_snd, u, flat, gc_seed, b2a_seed, count_field,
-                    garbler,
-                )
-            await _send(self._peer_writer, await _fetch(msg))
-        else:  # evaluator + OT receiver (inputs stay on device: each
-            # np.asarray here would cost a full tunnel round trip)
-            u, t_rows, idx0 = secure.ev_step1_fused(self._ot_rcv, flat)
-            await _send(self._peer_writer, await _fetch(u))
-            bmsg = await _recv(self._peer_reader)
-            if ot4:
-                vals = secure.ev_open_ot4(
-                    self._ot_rcv, t_rows, flat, bmsg, B, count_field, idx0
-                )
-            else:
-                vals = secure.ev_open_fused(
-                    self._ot_rcv, t_rows, bmsg, B, S, count_field, idx0
-                )
-        t2 = time.perf_counter()
-        vals = vals.reshape((F_, C, N) + count_field.limb_shape)
-        shares = secure.node_share_sums(count_field, vals, jnp.asarray(w))
-        shares = await _fetch(shares)
-        t3 = time.perf_counter()
-        for i, dt in enumerate((t1 - t0, t2 - t1, t3 - t2)):
-            self._phase_seconds[i] += dt
-        print(f"Tree searching and FSS - {t1 - t0:.4f}s")
-        print(f"Garbled Circuit and OT - {t2 - t1:.4f}s")
-        print(f"Field actions - {t3 - t2:.4f}s")
+        with self.obs.span("fss", level=level) as sp_fss:
+            # dispatch time only: the FSS expansion itself overlaps the
+            # exchange below (no sync — a block_until_ready here would
+            # cost a tunnel RTT)
+            packed, self._children = collect.expand_share_bits(
+                self.keys, self.frontier, level, want_children=not last
+            )
+            d = self.keys.cw_seed.shape[1]
+            C, S = 1 << d, 2 * d
+            strs = secure.child_strings(packed, d)  # [F, C, N, S]
+            F_, _, N, _ = strs.shape
+            B = F_ * C * N
+            self.obs.count("gc_tests", B, level=level)
+            self.obs.gauge("ot_batch_size", B * S, level=level)
+            flat = strs.reshape(B, S)
+        with self.obs.span("gc_ot", level=level) as sp_gc:
+            w = secure.alive_weight(self.frontier.alive, self.alive_keys, C)
+            # crawl counter makes every garbling's randomness unique even
+            # if a leader re-crawls a level without reset (seed reuse with
+            # a fixed R = s would leak cross-run equality deltas to the
+            # evaluator)
+            self._crawl_ctr += 1
+            gc_seed = secure.derive_seed(self._sec_seed, 1, level, self._crawl_ctr)
+            b2a_seed = secure.derive_seed(self._sec_seed, 2, level, self._crawl_ctr)
+            ot4 = secure._ot4_use(S)  # S == 2: 1-of-4 OT, no garbled circuit
+            if self.server_id == garbler:  # garbler/sender + OT-ext sender
+                u = await self._dp_recv()
+                if ot4:
+                    msg, vals = secure.gb_step_ot4(
+                        self._ot_snd, u, flat, b2a_seed, count_field, garbler
+                    )
+                else:
+                    msg, vals = secure.gb_step_fused(
+                        self._ot_snd, u, flat, gc_seed, b2a_seed, count_field,
+                        garbler,
+                    )
+                await self._dp_send(await _fetch(msg, self.obs))
+            else:  # evaluator + OT receiver (inputs stay on device: each
+                # np.asarray here would cost a full tunnel round trip)
+                u, t_rows, idx0 = secure.ev_step1_fused(self._ot_rcv, flat)
+                await self._dp_send(await _fetch(u, self.obs))
+                bmsg = await self._dp_recv()
+                if ot4:
+                    vals = secure.ev_open_ot4(
+                        self._ot_rcv, t_rows, flat, bmsg, B, count_field, idx0
+                    )
+                else:
+                    vals = secure.ev_open_fused(
+                        self._ot_rcv, t_rows, bmsg, B, S, count_field, idx0
+                    )
+        with self.obs.span("field", level=level) as sp_field:
+            vals = vals.reshape((F_, C, N) + count_field.limb_shape)
+            shares = secure.node_share_sums(count_field, vals, jnp.asarray(w))
+            shares = await _fetch(shares, self.obs)
+        self._emit_level_phases(level, sp_fss, sp_gc, sp_field)
         return shares
 
     async def tree_crawl(self, req) -> np.ndarray:
@@ -515,7 +563,9 @@ class CollectorServer:
         if self.server_id == 0:
             # FE62.add is a jnp op: fetch off-loop like every other
             # device->host transfer in the data plane (see _fetch)
-            return await _fetch(FE62.add(counts.astype(np.uint64), r))
+            return await _fetch(
+                FE62.add(counts.astype(np.uint64), r), self.obs, level=level
+            )
         return r
 
     async def tree_crawl_last(self, req) -> np.ndarray:
@@ -533,7 +583,7 @@ class CollectorServer:
             if self.server_id == 0:
                 c = np.zeros(counts.shape + (8,), np.uint32)
                 c[..., 0] = counts
-                shares = await _fetch(F255.add(c, r))
+                shares = await _fetch(F255.add(c, r), self.obs, level=level)
             else:
                 shares = r
         self._last_shares = shares
@@ -558,6 +608,7 @@ class CollectorServer:
             )
         if self._sketch is not None:
             self._advance_sketch(int(level), parent, pat_bits, n_alive)
+        self.obs.gauge("survivors", n_alive, level=int(level))
         return True
 
     async def tree_prune_last(self, req) -> bool:
@@ -579,6 +630,9 @@ class CollectorServer:
             self._advance_sketch(
                 L - 1, np.asarray(req["parent_idx"], np.int32), pattern, n_alive
             )
+        self.obs.gauge(
+            "survivors", n_alive, level=self.keys.cw_seed.shape[-2] - 1
+        )
         return True
 
     async def final_shares(self, req) -> dict:
@@ -623,7 +677,10 @@ class CollectorServer:
                 resp = {"__error__": f"{type(e).__name__}: {e}"}
             try:
                 async with write_lock:
-                    await _send(writer, (req_id, resp))
+                    await _send(
+                        writer, (req_id, resp),
+                        count=lambda n: self.obs.count("control_bytes_sent", n),
+                    )
             except (ConnectionResetError, BrokenPipeError):
                 pass  # leader gone; the work itself must still have finished
             except RuntimeError:
@@ -636,7 +693,10 @@ class CollectorServer:
         tasks = set()
         try:
             while True:
-                req_id, verb, req = await _recv(reader)
+                req_id, verb, req = await _recv(
+                    reader,
+                    count=lambda n: self.obs.count("control_bytes_recv", n),
+                )
                 if verb not in self._VERBS:
                     raise ValueError(f"unknown verb {verb!r}")
                 t = asyncio.create_task(handle(req_id, verb, req))
@@ -703,24 +763,27 @@ class CollectorServer:
         """Bring up the data plane FIRST (like the reference: GC mesh before
         the RPC listener, server.rs:344-354), run the base-OT handshake if
         the exchange is secure, then serve the leader."""
-        if self.server_id == 1:
-            srv = await asyncio.start_server(self._on_peer, host, peer_port)
-            self._peer_ready = asyncio.Event()
-            self._peer_srv = srv
-            await self._peer_ready.wait()
-        else:
-            for attempt in range(20):  # connect_with_retries_tcp, server.rs:235
-                try:
-                    r, w = await asyncio.open_connection(peer_host, peer_port)
-                    break
-                except OSError:
-                    await asyncio.sleep(0.25)
+        with self.obs.span("setup"):
+            if self.server_id == 1:
+                srv = await asyncio.start_server(self._on_peer, host, peer_port)
+                self._peer_ready = asyncio.Event()
+                self._peer_srv = srv
+                await self._peer_ready.wait()
             else:
-                raise ConnectionError("peer data-plane unreachable")
-            self._peer_reader, self._peer_writer = r, w
-            self._keepalive(w)
-            await self._plane_handshake()
-        self._rpc_srv = await asyncio.start_server(self._handle_leader, host, port)
+                for attempt in range(20):  # connect_with_retries_tcp, server.rs:235
+                    try:
+                        r, w = await asyncio.open_connection(peer_host, peer_port)
+                        break
+                    except OSError:
+                        await asyncio.sleep(0.25)
+                else:
+                    raise ConnectionError("peer data-plane unreachable")
+                self._peer_reader, self._peer_writer = r, w
+                self._keepalive(w)
+                await self._plane_handshake()
+            self._rpc_srv = await asyncio.start_server(
+                self._handle_leader, host, port
+            )
         return self._rpc_srv
 
     async def _on_peer(self, reader, writer):
@@ -758,14 +821,14 @@ class CollectorServer:
         for g in (0, 1):
             if self.server_id == g:  # extension sender <- base-OT receiver
                 s_bits = otext.fresh_s_bits()
-                a_msg = await _recv(self._peer_reader)
+                a_msg = await self._dp_recv()
                 br = baseot.BaseOtReceiver(s_bits)
-                await _send(self._peer_writer, br.round1(a_msg))
+                await self._dp_send(br.round1(a_msg))
                 self._ot_snd = otext.OtExtSender(s_bits, br.seeds())
             else:  # extension receiver <- base-OT sender
                 bs = baseot.BaseOtSender()
-                await _send(self._peer_writer, bs.round1())
-                r_msgs = await _recv(self._peer_reader)
+                await self._dp_send(bs.round1())
+                r_msgs = await self._dp_recv()
                 s0, s1 = bs.seeds([baseot.decompress(m) for m in r_msgs])
                 self._ot_rcv = otext.OtExtReceiver(s0, s1)
         self._ot = (self._ot_snd, self._ot_rcv)  # marker: secure plane live
@@ -787,11 +850,14 @@ class CollectorClient:
     (tarpc's pipelining model, leader.rs:340-364 drives 1000 in-flight
     addkey batches through it)."""
 
-    def __init__(self, reader, writer):
+    def __init__(self, reader, writer, reg: obsmetrics.Registry | None = None):
         self._r, self._w = reader, writer
         self._send_lock = asyncio.Lock()
         self._pending: dict[int, asyncio.Future] = {}
         self._next_id = 0
+        # control-plane byte accounting lands on the leader process's
+        # default registry unless the caller owns one
+        self.obs = obsmetrics.default_registry() if reg is None else reg
         self._reader_task = asyncio.ensure_future(self._read_loop())
 
     @classmethod
@@ -807,7 +873,10 @@ class CollectorClient:
     async def _read_loop(self):
         try:
             while True:
-                req_id, resp = await _recv(self._r)
+                req_id, resp = await _recv(
+                    self._r,
+                    count=lambda n: self.obs.count("control_bytes_recv", n),
+                )
                 fut = self._pending.pop(req_id, None)
                 if fut is not None and not fut.done():
                     fut.set_result(resp)
@@ -826,7 +895,10 @@ class CollectorClient:
         fut = asyncio.get_event_loop().create_future()
         self._pending[req_id] = fut
         async with self._send_lock:
-            await _send(self._w, (req_id, verb, req or {}))
+            await _send(
+                self._w, (req_id, verb, req or {}),
+                count=lambda n: self.obs.count("control_bytes_sent", n),
+            )
         resp = await fut
         if isinstance(resp, dict) and "__error__" in resp:
             raise RuntimeError(f"server error on {verb}: {resp['__error__']}")

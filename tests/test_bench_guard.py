@@ -11,6 +11,8 @@ the level programs themselves still run once each (the correctness pin
 inside the bench asserts secure counts == trusted counts on every engine).
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -39,13 +41,14 @@ def test_contention_retry_min_merges_and_reports(monkeypatch):
 
     # call order inside bench_secure_device on a CPU host (no Pallas GC,
     # with_l512=False): gc_path, fe62, f255, trusted -> guard trips ->
-    # retry fe62, gc_path, trusted -> 2x-bucket point
+    # retry fe62, f255, gc_path, trusted -> 2x-bucket point
     script = iter([
         0.100,  # gc_path   (contended window)
         0.100,  # fe62      (contended window)
-        0.001,  # f255
+        0.020,  # f255      (contended window too: also > 8x trusted)
         0.001,  # trusted   -> fe62/trusted = 100 > 8: retry
         0.002,  # retry fe62
+        0.003,  # retry f255
         0.004,  # retry gc_path
         0.001,  # retry trusted
         0.003,  # 2x bucket
@@ -61,6 +64,7 @@ def test_contention_retry_min_merges_and_reports(monkeypatch):
     assert out["contention_retry"] is True
     # min-merge: the retried (clean) numbers replace the contended ones
     assert out["secure_device_ms_per_level_fe62"] == 2.0
+    assert out["secure_device_ms_per_level_f255"] == 3.0
     assert out["secure_device_ms_per_level_fe62_gc_path"] == 4.0
     assert out["trusted_same_shape_ms_per_level"] == 1.0
     # ratios are computed AFTER the retry, from the reported numbers
@@ -91,3 +95,48 @@ def test_no_retry_on_clean_window(monkeypatch):
     assert "contention_retry" not in out
     assert out["secure_over_trusted_ratio"] == 3.0
     np.testing.assert_allclose(out["ot4_speedup_vs_gc_path"], 4 / 3, rtol=0.02)
+
+
+def _pids_with_cmdline(marker: str) -> list[int]:
+    pids = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                if marker.encode() in f.read():
+                    pids.append(int(pid))
+        except OSError:
+            pass  # raced a process exit
+    return pids
+
+
+@pytest.mark.skipif(
+    not os.path.isdir("/proc"), reason="needs procfs to observe the child"
+)
+def test_subprocess_metric_kills_child_on_teardown():
+    """A driver SIGTERM / Ctrl-C landing while the parent is blocked in
+    communicate() must still TERM the child bench: the parent's
+    SIGTERM->SystemExit handler raises a BaseException that skips the
+    TimeoutExpired path, and a leaked child would keep crawling the
+    accelerator after the bench is gone."""
+    import signal
+
+    import bench
+
+    marker = f"fhh_teardown_probe_{os.getpid()}"
+    old = signal.signal(
+        signal.SIGALRM,
+        lambda *_: (_ for _ in ()).throw(KeyboardInterrupt()),
+    )
+    try:
+        signal.setitimer(signal.ITIMER_REAL, 1.0)
+        with pytest.raises(KeyboardInterrupt):
+            bench._subprocess_metric(
+                f"import time  # {marker}\ntime.sleep(120)", timeout_s=60
+            )
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old)
+    # the child was reaped before the interrupt propagated
+    assert _pids_with_cmdline(marker) == []

@@ -83,6 +83,9 @@ def test_binaries_end_to_end(tmp_path):
             stderr=subprocess.STDOUT, text=True,
         )
 
+    report_path = tmp_path / "leader_report.json"
+    env["FHH_RUN_REPORT"] = str(report_path)  # one shared path: the leader
+    # keeps it bare, each server claims a .s<id> sibling at startup
     s1 = spawn("fuzzyheavyhitters_tpu.bin.server", "--server_id", "1")
     s0 = spawn("fuzzyheavyhitters_tpu.bin.server", "--server_id", "0")
     lead = None
@@ -90,10 +93,27 @@ def test_binaries_end_to_end(tmp_path):
         lead = spawn("fuzzyheavyhitters_tpu.bin.leader", "-n", str(N_REQS))
         out, _ = lead.communicate(timeout=540)
         assert lead.returncode == 0, f"leader failed:\n{out[-4000:]}"
-        assert "Crawl done" in out
+        assert "crawl.done" in out  # obs-layer telemetry line
+        rep = json.loads(report_path.read_text())
+        assert rep["schema"] == "fhh-run-report/1"
+        assert "level" in rep["registries"]["leader"]["phases"]
         csv_path = tmp_path / "data" / "ride_heavy_hitters.csv"
         assert csv_path.exists(), out[-2000:]
         got = csv_path.read_text()
+        # drain the servers: SIGTERM -> SystemExit(143) -> each writes its
+        # OWN suffixed report instead of clobbering the leader's
+        for p in (s0, s1):
+            p.terminate()
+        for p in (s0, s1):
+            p.communicate(timeout=60)
+        for sid in (0, 1):
+            srep = json.loads(
+                (tmp_path / f"leader_report.s{sid}.json").read_text()
+            )
+            assert f"server{sid}" in srep["registries"], sorted(
+                srep["registries"]
+            )
+        assert json.loads(report_path.read_text()) == rep  # not clobbered
     finally:
         for p in (s0, s1, lead):
             if p is not None and p.poll() is None:
@@ -179,7 +199,7 @@ def test_mesh_binary_smoke(tmp_path):
         cwd=tmp_path, env=env, capture_output=True, text=True, timeout=540,
     )
     assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-2000:]
-    assert "Crawl done" in out.stdout
+    assert "crawl.done" in out.stdout + out.stderr  # obs telemetry line
     # NB no hitter-count assertion: the zipf workload appends 8 random
     # augmentation bits per request (leader.rs:331 parity), so leaf-level
     # hitters are luck at smoke scale; hitter correctness is pinned by the

@@ -234,6 +234,20 @@ def test_checkpoint_resume_matches_uninterrupted(rng, tmp_path):
     lead_d = driver.Leader(s0d, s1d, n_dims=d, data_len=L, f_max=64)
     with pytest.raises(ValueError, match="different key batches"):
         lead_d.restore(ck)
+    # (b') same RNG seed, DIFFERENT ball radius -> refused.  Root seeds
+    # are identical here and the correction words diverge only at the
+    # DEEP levels (the radius perturbs the interval endpoints' low bits),
+    # so this pins that the fingerprint covers the full level axis.
+    bk0, bk1 = ibdcf.gen_l_inf_ball(
+        pts_bits, ball + 1, np.random.default_rng(99), engine="np"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(bk0.root_seed), np.asarray(k0.root_seed)
+    )  # the scenario is real: only the cw planes differ
+    s0g, s1g = driver.make_servers(bk0, bk1)
+    lead_g = driver.Leader(s0g, s1g, n_dims=d, data_len=L, f_max=64)
+    with pytest.raises(ValueError, match="different key batches"):
+        lead_g.restore(ck)
 
     # fresh leader over the SAME keys resumes from disk; run()-written
     # checkpoints also carry (nreqs, threshold), so a mid-crawl file from
@@ -265,6 +279,44 @@ def test_checkpoint_resume_matches_uninterrupted(rng, tmp_path):
         lead_f.run(
             nreqs=n, threshold=0.5, checkpoint_path=ck, resume=True
         )
+
+
+@pytest.mark.parametrize("client", [2, 79])
+def test_key_fingerprint_covers_every_client(client):
+    """The fingerprint's client-axis checksum covers EVERY client: two
+    key batches with identical roots that diverge at any single client —
+    an interior one (2: unsampled by any 64-slot prefix or spread
+    sample of 80) or the endpoint (79) — must fingerprint differently."""
+    L, d, n = 6, 1, 80
+    rng = np.random.default_rng(3)
+    pts = rng.integers(0, 1 << L, size=(n, d))
+    pts2 = pts.copy()
+    pts2[client] = (pts2[client] + 1) % (1 << L)  # ONE client differs
+
+    def keys(p):
+        bits = np.array(
+            [[bitutils.int_to_bits(L, int(v)) for v in row] for row in p]
+        )
+        return ibdcf.gen_l_inf_ball(
+            bits, 1, np.random.default_rng(11), engine="np"
+        )
+
+    def fingerprint(k0, k1):
+        s0, s1 = driver.make_servers(k0, k1)
+        lead = driver.Leader(s0, s1, n_dims=d, data_len=L, f_max=64)
+        return lead._key_fingerprint()
+
+    ka = keys(pts)
+    kb = keys(pts2)
+    # the scenario is real: same rng seed -> identical roots, so only the
+    # cw planes (at the one divergent client) can tell the batches apart
+    np.testing.assert_array_equal(
+        np.asarray(ka[0].root_seed), np.asarray(kb[0].root_seed)
+    )
+    fp_a, fp_b = fingerprint(*ka), fingerprint(*kb)
+    assert not np.array_equal(fp_a, fp_b)
+    # and identical batches still agree (the fingerprint is deterministic)
+    assert np.array_equal(fp_a, fingerprint(*keys(pts)))
 
 
 def test_checkpoint_resume_streaming_mode(rng, tmp_path):
