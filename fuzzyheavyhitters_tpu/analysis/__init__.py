@@ -1,0 +1,49 @@
+"""fhh-lint: AST-based static analysis for this codebase's invariants.
+
+A dependency-free lint framework (``ast`` + ``tokenize`` only — it never
+imports JAX or the modules under lint) enforcing the three invariant
+families reviewer vigilance kept missing:
+
+- **trace-safety / performance** — no host synchronization on the
+  per-level crawl path, no per-call jit wrapper churn
+  (``host-sync-in-hot-loop``, ``recompile-churn``);
+- **secret hygiene** — seeds, correction words, GC labels, and MAC keys
+  never flow into logs, metrics, stdout, or exception messages
+  (``secret-to-sink``);
+- **thread safety + failure honesty** — module-level shared state only
+  written under its registered lock; no silent catch-alls; no bare
+  print telemetry (``unguarded-shared-state``, ``broad-except``,
+  ``bare-print``).
+
+Usage::
+
+    python -m fuzzyheavyhitters_tpu.analysis [paths] \
+        [--format human|json] [--strict] [--update-baseline]
+
+Inline suppression: ``# fhh-lint: disable=<rule>[,<rule>]`` on the
+offending line (or alone on the line above).  Grandfathered findings
+live in ``lint_baseline.json`` as per-(rule, file) counts that must not
+grow; see :mod:`.baseline`.  Config: ``[tool.fhh-lint]`` in
+pyproject.toml (:mod:`.config`).
+"""
+
+from .baseline import apply_baseline, load_baseline, write_baseline
+from .config import LintConfig, find_repo_root, load_config
+from .engine import Finding, Rule, SourceModule, lint_paths, lint_source
+from .rules import ALL_RULES, RULES_BY_NAME
+
+__all__ = [
+    "ALL_RULES",
+    "Finding",
+    "LintConfig",
+    "Rule",
+    "RULES_BY_NAME",
+    "SourceModule",
+    "apply_baseline",
+    "find_repo_root",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "load_config",
+    "write_baseline",
+]

@@ -1,0 +1,191 @@
+"""fhh-lint CLI: ``python -m fuzzyheavyhitters_tpu.analysis [paths ...]``.
+
+Exit codes: 0 clean (every finding suppressed or baselined), 1 new
+findings at/above the failure threshold (``error`` by default; any
+severity under ``--strict``), 2 usage or internal error.
+
+``--format json`` emits one machine-readable document on stdout (the
+artifact scripts/lint.sh archives); human mode prints one line per
+finding plus a summary.  ``--update-baseline`` rewrites the baseline to
+the current tree's findings and exits 0 — the burn-down workflow is:
+fix findings, run ``--update-baseline``, commit the shrunken file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .baseline import apply_baseline, load_baseline, write_baseline
+from .config import find_repo_root, load_config
+from .engine import iter_python_files, lint_paths
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m fuzzyheavyhitters_tpu.analysis",
+        description="fhh-lint: AST static analysis for trace-safety, "
+        "secret hygiene, and thread safety",
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories to lint (default: from config)",
+    )
+    p.add_argument(
+        "--format",
+        choices=("human", "json"),
+        default="human",
+        help="output format (default: human)",
+    )
+    p.add_argument(
+        "--strict",
+        action="store_true",
+        help="fail on ANY new finding, warnings included",
+    )
+    p.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline JSON path (default: from config, repo-relative)",
+    )
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline: every finding is new",
+    )
+    p.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline to the current findings and exit 0",
+    )
+    p.add_argument(
+        "--root",
+        default=None,
+        help="repo root (default: nearest ancestor with pyproject.toml)",
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    root = os.path.abspath(args.root) if args.root else find_repo_root()
+    cfg = load_config(root)
+    paths = args.paths or list(cfg.default_paths)
+    missing, nonpy = [], []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if not os.path.exists(ap):
+            missing.append(p)
+        elif os.path.isfile(ap) and not ap.endswith(".py"):
+            nonpy.append(p)
+    if missing:
+        print(f"fhh-lint: no such path: {', '.join(missing)}", file=sys.stderr)
+        return 2
+    if nonpy:
+        print(
+            f"fhh-lint: not a Python file: {', '.join(nonpy)}",
+            file=sys.stderr,
+        )
+        return 2
+    # enumerate ONCE: the file list feeds the lint pass, and its relpath
+    # set scopes both the stale-baseline check and --update-baseline's
+    # keep logic to what this run actually linted
+    files = list(iter_python_files(paths, root))
+    scanned = {rel for _, rel in files}
+    if not files:
+        print(
+            "fhh-lint: no Python files found under the given paths",
+            file=sys.stderr,
+        )
+        return 2
+
+    findings, errors = lint_paths(paths, cfg, root, files=files)
+
+    baseline_path = args.baseline or os.path.join(root, cfg.baseline)
+    if args.update_baseline:
+        if errors:
+            # an unparseable file would silently drop its counts from the
+            # rewritten baseline — refuse instead
+            for e in errors:
+                print(f"fhh-lint: PARSE ERROR {e}", file=sys.stderr)
+            print(
+                "fhh-lint: refusing --update-baseline with parse errors",
+                file=sys.stderr,
+            )
+            return 2
+        # entries for files OUTSIDE this run's path set survive the
+        # rewrite (a partial-tree update must not erase another subtree's
+        # grandfathered findings) — but only while the file still exists:
+        # deleted/renamed files' entries must be removable
+        try:
+            old = load_baseline(baseline_path)
+        except (ValueError, OSError, json.JSONDecodeError):
+            old = {}
+        keep = {
+            rule: {
+                p: n
+                for p, n in per_path.items()
+                if p not in scanned and os.path.exists(os.path.join(root, p))
+            }
+            for rule, per_path in old.items()
+        }
+        write_baseline(baseline_path, findings, keep=keep)
+        kept = sum(len(v) for v in keep.values() if v)
+        print(
+            f"fhh-lint: baseline rewritten with {len(findings)} finding(s)"
+            + (f" (+{kept} unscanned entr{'y' if kept == 1 else 'ies'} kept)"
+               if kept else "")
+            + f" -> {os.path.relpath(baseline_path, root)}"
+        )
+        return 0
+
+    counts = {}
+    if not args.no_baseline:
+        try:
+            counts = load_baseline(baseline_path)
+        except (ValueError, OSError, json.JSONDecodeError) as e:
+            print(f"fhh-lint: bad baseline: {e}", file=sys.stderr)
+            return 2
+    res = apply_baseline(findings, counts, scanned=scanned)
+
+    threshold = ("warning", "error") if args.strict else ("error",)
+    failing = [f for f in res.new if f.severity in threshold]
+
+    if args.format == "json":
+        doc = {
+            "schema": "fhh-lint-report/1",
+            "root": root,
+            "paths": paths,
+            "strict": bool(args.strict),
+            "findings": [f.to_json() for f in res.new],
+            "baselined": res.absorbed,
+            "stale_baseline": [
+                {"rule": r, "path": p, "extra": n} for r, p, n in res.stale
+            ],
+            "parse_errors": errors,
+            "failing": len(failing),
+        }
+        print(json.dumps(doc, indent=1))
+    else:
+        for f in res.new:
+            print(f.render())
+        for e in errors:
+            print(f"PARSE ERROR {e}")
+        bits = [f"{len(res.new)} new finding(s)"]
+        if res.absorbed:
+            bits.append(f"{res.absorbed} baselined")
+        if res.stale:
+            bits.append(
+                f"{len(res.stale)} stale baseline entr"
+                f"{'y' if len(res.stale) == 1 else 'ies'} "
+                "(run --update-baseline to bank the burn-down)"
+            )
+        if errors:
+            bits.append(f"{len(errors)} parse error(s)")
+        print("fhh-lint: " + ", ".join(bits))
+
+    if errors:
+        return 2
+    return 1 if failing else 0

@@ -1,0 +1,211 @@
+"""fhh-lint configuration: defaults + ``[tool.fhh-lint]`` in pyproject.toml.
+
+This interpreter predates :mod:`tomllib` (3.11) and the repo bakes in no
+third-party TOML reader, so :func:`_read_toml_subset` parses exactly the
+subset the config uses — ``[table.sub]`` headers, ``key = value`` with
+string / integer / boolean / list-of-string values (lists may span
+lines) — and rejects nothing it doesn't understand (unknown constructs
+are skipped line-wise; the linter must never crash on someone's build
+metadata living in the same file).
+
+Schema: every :class:`LintConfig` field name is a valid ``[tool.fhh-lint]``
+key (list fields as TOML string arrays, ``baseline`` as a string), plus a
+``[tool.fhh-lint.severity]`` table mapping rule name -> severity.  The
+checked-in ``pyproject.toml`` is the operative copy for THIS repo; the
+dataclass defaults below mirror it so the linter behaves identically when
+pointed at a tree with no pyproject (a drift test in test_analysis.py
+keeps the two in sync — edit pyproject, it will tell you to update here).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass
+class LintConfig:
+    # host-sync rule: path prefixes whose loop bodies are hot, and
+    # function names that ARE the per-level crawl path (their in-module
+    # transitive callees inherit hotness)
+    hot_modules: tuple = (
+        "fuzzyheavyhitters_tpu/protocol",
+        "fuzzyheavyhitters_tpu/ops",
+        "fuzzyheavyhitters_tpu/parallel",
+    )
+    hot_roots: tuple = (
+        "run_level",
+        "tree_crawl",
+        "tree_crawl_last",
+        "tree_prune",
+        "tree_prune_last",
+        "sketch_verify",
+        "expand_share_bits",
+        "expand_share_bits_from_cw",
+        "advance_from_children",
+        "advance_from_cw",
+    )
+    # secret-to-sink rule: identifier segments naming key material (split
+    # on "_"; an identifier matches when any segment is in the lexicon)
+    secret_lexicon: tuple = (
+        "seed",
+        "seeds",
+        "cw",
+        "cws",
+        "cwf",
+        "cwv",
+        "delta",
+        "label",
+        "labels",
+        "triples",
+        "mac",
+        "secret",
+    )
+    sink_calls: tuple = ("emit", "print")
+    # bare-print rule: applies under print_scope minus print_allowed
+    print_scope: tuple = ("fuzzyheavyhitters_tpu",)
+    print_allowed: tuple = (
+        "fuzzyheavyhitters_tpu/workloads/ride_austin_visualization.py",
+        "fuzzyheavyhitters_tpu/workloads/covid_data_visualization.py",
+        # the linter's own CLI: stdout IS its program-output channel
+        "fuzzyheavyhitters_tpu/analysis/cli.py",
+    )
+    # unguarded-shared-state rule: modules whose module-level mutables
+    # must only be written under a registered lock
+    shared_state_modules: tuple = (
+        "fuzzyheavyhitters_tpu/obs",
+        "fuzzyheavyhitters_tpu/native",
+        "fuzzyheavyhitters_tpu/protocol/rpc.py",
+    )
+    severity_overrides: dict = field(default_factory=dict)
+    baseline: str = "lint_baseline.json"
+    default_paths: tuple = ("fuzzyheavyhitters_tpu", "tests")
+
+
+_KV_RE = re.compile(r"^\s*([A-Za-z0-9_\-\"']+)\s*=\s*(.+?)\s*$")
+_HDR_RE = re.compile(r"^\s*\[([^\]]+)\]\s*$")
+
+
+def _strip_comment(line: str) -> str:
+    """Drop a trailing ``#`` comment, respecting string quotes."""
+    out = []
+    quote = None
+    for ch in line:
+        if quote:
+            if ch == quote:
+                quote = None
+        elif ch in "\"'":
+            quote = ch
+        elif ch == "#":
+            break
+        out.append(ch)
+    return "".join(out)
+
+
+def _parse_value(raw: str):
+    raw = raw.strip()
+    if raw.startswith("["):
+        inner = raw[1:-1] if raw.endswith("]") else raw[1:]
+        items = []
+        for part in inner.split(","):
+            part = part.strip()
+            if len(part) >= 2 and part[0] in "\"'" and part[-1] == part[0]:
+                items.append(part[1:-1])
+        return items
+    if len(raw) >= 2 and raw[0] in "\"'" and raw[-1] == raw[0]:
+        return raw[1:-1]
+    if raw in ("true", "false"):
+        return raw == "true"
+    try:
+        return int(raw)
+    except ValueError:
+        return raw
+
+
+def _read_toml_subset(path: str) -> dict:
+    """pyproject.toml -> nested dict of the subset described above."""
+    root: dict = {}
+    table = root
+    pending_key = None
+    pending_buf: list[str] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = _strip_comment(line).rstrip()
+            if pending_key is not None:
+                pending_buf.append(line)
+                if line.strip().endswith("]"):
+                    table[pending_key] = _parse_value(" ".join(pending_buf))
+                    pending_key, pending_buf = None, []
+                continue
+            if not line.strip():
+                continue
+            hdr = _HDR_RE.match(line)
+            if hdr:
+                table = root
+                for part in hdr.group(1).split("."):
+                    part = part.strip().strip("\"'")
+                    table = table.setdefault(part, {})
+                continue
+            kv = _KV_RE.match(line)
+            if not kv:
+                continue
+            key = kv.group(1).strip("\"'")
+            raw = kv.group(2)
+            if raw.startswith("[") and not raw.endswith("]"):
+                pending_key, pending_buf = key, [raw]
+                continue
+            table[key] = _parse_value(raw)
+    return root
+
+
+def find_repo_root(start: str | None = None) -> str:
+    """Nearest ancestor holding pyproject.toml (else ``start`` itself)."""
+    cur = os.path.abspath(start or os.getcwd())
+    probe = cur
+    while True:
+        if os.path.exists(os.path.join(probe, "pyproject.toml")):
+            return probe
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            return cur
+        probe = parent
+
+
+def load_config(root: str | None = None, pyproject: str | None = None) -> LintConfig:
+    """Config from ``[tool.fhh-lint]`` merged over the defaults."""
+    cfg = LintConfig()
+    if pyproject is None:
+        if root is None:
+            root = find_repo_root()
+        pyproject = os.path.join(root, "pyproject.toml")
+    if not os.path.exists(pyproject):
+        return cfg
+    try:
+        doc = _read_toml_subset(pyproject)
+    except OSError:
+        return cfg
+    section = doc.get("tool", {}).get("fhh-lint", {})
+    if not isinstance(section, dict):
+        return cfg
+    for key in (
+        "hot_modules",
+        "hot_roots",
+        "secret_lexicon",
+        "sink_calls",
+        "print_scope",
+        "print_allowed",
+        "shared_state_modules",
+        "default_paths",
+    ):
+        val = section.get(key)
+        if isinstance(val, list):
+            setattr(cfg, key, tuple(val))
+    if isinstance(section.get("baseline"), str):
+        cfg.baseline = section["baseline"]
+    sev = section.get("severity")
+    if isinstance(sev, dict):
+        cfg.severity_overrides = {
+            k: v for k, v in sev.items() if isinstance(v, str)
+        }
+    return cfg

@@ -1,0 +1,620 @@
+"""The fhh-lint rule set, tuned to this codebase's invariants.
+
+Six rules over five concerns (the broad-except/bare-print concern ships
+as two rules so suppressions and severities stay per-rule):
+
+- ``host-sync-in-hot-loop`` — device->host synchronization primitives
+  (``.item()``, ``np.asarray``, ``jax.device_get``,
+  ``.block_until_ready()``; plus ``bool``/``int``/``float`` casts under
+  jit, which force a tracer sync or fail outright) reachable from the
+  per-level crawl path: lexically inside a loop in a hot module, inside
+  a configured hot-root function or its in-module transitive callees, or
+  inside any jit-decorated function.  The sanctioned fetch path
+  (``protocol.rpc._fetch``: off-event-loop, obs-counted) never trips
+  this rule — raw syncs in the crawl are exactly the smell.
+- ``secret-to-sink`` — identifiers matching the secret lexicon (seeds,
+  correction-word planes, GC labels, Δ, MAC keys) flowing into log/emit
+  calls, ``print``, or exception messages.  A crawl that logs a seed has
+  leaked a client's key share to whoever reads the log.
+- ``recompile-churn`` — ``jax.jit``/``jax.pmap`` wrappers created inside
+  function bodies (a fresh wrapper per call = a fresh compile cache per
+  call), ``pallas_call`` constructed inside a lexical loop, and static
+  arguments of module-local jit functions fed unhashable literals or
+  loop variables (one XLA compile per iteration).
+- ``unguarded-shared-state`` — module-level mutables in the configured
+  shared-state modules written outside every registered lock
+  (module-level ``threading.Lock/RLock``/``asyncio.Lock``).  The obs
+  registries are read by the heartbeat thread concurrently with the
+  event loop; an unlocked write there is a data race by construction.
+- ``broad-except`` — bare ``except:`` or ``except Exception`` handlers
+  that neither re-raise nor call pytest's raising helpers.  Catch-all
+  boundaries that are deliberate (an RPC verb handler surfacing errors
+  to its caller) carry an inline suppression with a justification.
+- ``bare-print`` — ``print()`` in crawl-path package modules (the
+  ``test_obs`` stdout-hygiene guard, generalized): telemetry goes
+  through ``obs.emit``; stdout stays a clean program-output channel.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Rule, SourceModule, dotted_name, last_segment
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+_JIT_NAMES = ("jit", "pmap")
+
+
+def _mentions_jit(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in _JIT_NAMES:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _JIT_NAMES:
+            return True
+    return False
+
+
+def _is_jit_decorated(fn) -> bool:
+    return any(_mentions_jit(dec) for dec in fn.decorator_list)
+
+
+def _module_functions(mod: SourceModule) -> dict:
+    """bare name -> list of (Async)FunctionDef anywhere in the module."""
+    defs: dict[str, list] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    return defs
+
+
+def _callee_names(fn) -> set[str]:
+    """Bare names this function calls (``f(...)``, ``obj.f(...)``)."""
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            seg = last_segment(dotted_name(node.func))
+            if seg:
+                out.add(seg)
+    return out
+
+
+def _hot_functions(mod: SourceModule, cfg) -> set[str]:
+    """Configured hot roots plus their in-module transitive callees."""
+    defs = _module_functions(mod)
+    hot = {name for name in cfg.hot_roots if name in defs}
+    work = list(hot)
+    while work:
+        name = work.pop()
+        for fn in defs[name]:
+            for callee in _callee_names(fn):
+                if callee in defs and callee not in hot:
+                    hot.add(callee)
+                    work.append(callee)
+    return hot
+
+
+def _under_prefix(relpath: str, prefixes) -> bool:
+    return any(
+        relpath == p or relpath.startswith(p.rstrip("/") + "/")
+        for p in prefixes
+    )
+
+
+def _loop_targets(mod: SourceModule, node: ast.AST) -> set[str]:
+    """Names bound as ``for`` targets in loops enclosing ``node`` (within
+    the nearest function boundary)."""
+    out: set[str] = set()
+    for a in mod.ancestors(node):
+        if isinstance(a, (ast.For, ast.AsyncFor)):
+            for t in ast.walk(a.target):
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break
+    return out
+
+
+def _span(node: ast.AST):
+    return node.lineno, getattr(node, "end_lineno", node.lineno)
+
+
+# ---------------------------------------------------------------------------
+# 1. host-sync-in-hot-loop
+# ---------------------------------------------------------------------------
+
+_HOST_SYNC_DOTTED = {
+    "np.asarray": "np.asarray",
+    "numpy.asarray": "np.asarray",
+    "jax.device_get": "jax.device_get",
+    "device_get": "jax.device_get",
+}
+_HOST_SYNC_METHODS = {"item", "block_until_ready"}
+_TRACER_CASTS = {"bool", "int", "float"}
+
+
+class HostSyncInHotLoop(Rule):
+    name = "host-sync-in-hot-loop"
+    default_severity = "warning"
+
+    def check(self, mod: SourceModule, cfg):
+        in_hot_module = _under_prefix(mod.relpath, cfg.hot_modules)
+        hot_fns = _hot_functions(mod, cfg) if in_hot_module else set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            sync = self._sync_kind(node)
+            cast = self._cast_kind(node)
+            if sync is None and cast is None:
+                continue
+            chain = mod.enclosing_functions(node)
+            jit_fn = next((f for f in chain if _is_jit_decorated(f)), None)
+            if jit_fn is not None:
+                what = sync or f"{cast}() cast"
+                yield (
+                    *_span(node),
+                    f"{what} inside jit-compiled function "
+                    f"'{jit_fn.name}' forces a host sync on every call",
+                )
+                continue
+            if sync is None or not in_hot_module:
+                continue  # bare casts only matter under jit
+            hot_fn = next((f.name for f in chain if f.name in hot_fns), None)
+            if mod.in_loop_within_function(node):
+                yield (
+                    *_span(node),
+                    f"{sync} inside a loop in hot module "
+                    f"{mod.relpath} blocks on a device round trip per "
+                    "iteration (batch or hoist it, or route it through "
+                    "the counted _fetch helper)",
+                )
+            elif hot_fn is not None:
+                yield (
+                    *_span(node),
+                    f"{sync} on the per-level crawl path "
+                    f"(reachable from hot root via '{hot_fn}') costs a "
+                    "device round trip per level (batch or hoist it, or "
+                    "route it through the counted _fetch helper)",
+                )
+
+    @staticmethod
+    def _sync_kind(call: ast.Call) -> str | None:
+        dn = dotted_name(call.func)
+        if dn in _HOST_SYNC_DOTTED:
+            return _HOST_SYNC_DOTTED[dn]
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr in _HOST_SYNC_METHODS
+            and not call.args
+            and not call.keywords
+        ):
+            return f".{call.func.attr}()"
+        return None
+
+    @staticmethod
+    def _cast_kind(call: ast.Call) -> str | None:
+        # only casts of computed expressions (a call result, an element, an
+        # attribute) — bool(p) of a plain local is almost always a static
+        # Python value, not a tracer
+        if (
+            isinstance(call.func, ast.Name)
+            and call.func.id in _TRACER_CASTS
+            and len(call.args) == 1
+            and not call.keywords
+            and isinstance(call.args[0], (ast.Call, ast.Subscript, ast.Attribute))
+        ):
+            return call.func.id
+        return None
+
+
+# ---------------------------------------------------------------------------
+# 2. secret-to-sink
+# ---------------------------------------------------------------------------
+
+
+def _secret_match(identifier: str, lexicon) -> bool:
+    segments = [s for s in identifier.lower().split("_") if s]
+    return any(s in lexicon for s in segments)
+
+
+def _secret_idents(node: ast.AST, lexicon) -> list[str]:
+    """Secret-matching identifiers appearing anywhere in an expression
+    (f-string holes, call args, attribute chains included)."""
+    out = []
+    for n in ast.walk(node):
+        ident = None
+        if isinstance(n, ast.Name):
+            ident = n.id
+        elif isinstance(n, ast.Attribute):
+            ident = n.attr
+        elif isinstance(n, ast.arg):
+            ident = n.arg
+        if ident and _secret_match(ident, lexicon):
+            out.append(ident)
+    return out
+
+
+class SecretToSink(Rule):
+    name = "secret-to-sink"
+    default_severity = "error"
+
+    def check(self, mod: SourceModule, cfg):
+        lexicon = set(cfg.secret_lexicon)
+        sinks = set(cfg.sink_calls)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                seg = last_segment(dotted_name(node.func))
+                if seg not in sinks:
+                    continue
+                leaked = []
+                for arg in node.args:
+                    leaked += _secret_idents(arg, lexicon)
+                for kw in node.keywords:
+                    leaked += _secret_idents(kw.value, lexicon)
+                    if (
+                        kw.arg
+                        and _secret_match(kw.arg, lexicon)
+                        and not isinstance(kw.value, ast.Constant)
+                    ):
+                        leaked.append(kw.arg)
+                if leaked:
+                    yield (
+                        *_span(node),
+                        f"secret-lexicon identifier(s) "
+                        f"{sorted(set(leaked))} flow into sink "
+                        f"'{seg}' — key material must never reach "
+                        "logs, metrics, or stdout",
+                    )
+            elif isinstance(node, ast.Raise) and node.exc is not None:
+                leaked = _secret_idents(node.exc, lexicon)
+                if leaked:
+                    yield (
+                        *_span(node),
+                        f"secret-lexicon identifier(s) "
+                        f"{sorted(set(leaked))} flow into an exception "
+                        "message — tracebacks cross trust boundaries "
+                        "(RPC error responses, logs)",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# 3. recompile-churn
+# ---------------------------------------------------------------------------
+
+_UNHASHABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+
+
+def _jit_static_params(fn) -> set[str] | None:
+    """For a jit-decorated function, the parameter names declared static
+    (via static_argnames or static_argnums); None when not jit-decorated."""
+    if not _is_jit_decorated(fn):
+        return None
+    statics: set[str] = set()
+    arg_names = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for dec in fn.decorator_list:
+        for n in ast.walk(dec):
+            if not isinstance(n, ast.Call):
+                continue
+            for kw in n.keywords:
+                if kw.arg == "static_argnames":
+                    for c in ast.walk(kw.value):
+                        if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                            statics.add(c.value)
+                elif kw.arg == "static_argnums":
+                    for c in ast.walk(kw.value):
+                        if isinstance(c, ast.Constant) and isinstance(c.value, int):
+                            if 0 <= c.value < len(arg_names):
+                                statics.add(arg_names[c.value])
+    return statics
+
+
+class RecompileChurn(Rule):
+    name = "recompile-churn"
+    default_severity = "warning"
+
+    def check(self, mod: SourceModule, cfg):
+        # module-local jit functions and their static params
+        jit_statics: dict[str, tuple[set[str], list[str]]] = {}
+        for name, fns in _module_functions(mod).items():
+            for fn in fns:
+                statics = _jit_static_params(fn)
+                if statics:
+                    arg_names = [
+                        a.arg for a in fn.args.posonlyargs + fn.args.args
+                    ]
+                    jit_statics[name] = (statics, arg_names)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._in_decorator(mod, node):
+                continue
+            dn = dotted_name(node.func)
+            seg = last_segment(dn)
+            chain = mod.enclosing_functions(node)
+            if seg in _JIT_NAMES and chain:
+                # jax.jit(f) inside a function body: a fresh wrapper (and
+                # compile cache) per call.  Trace-time creation inside an
+                # already-jit-decorated function is fine.
+                if not any(_is_jit_decorated(f) for f in chain):
+                    yield (
+                        *_span(node),
+                        f"'{dn}' wrapper created inside function "
+                        f"'{chain[0].name}' — hoist to module scope or "
+                        "the compile cache is rebuilt on every call",
+                    )
+                continue
+            if seg == "pallas_call" and mod.in_loop_within_function(node):
+                yield (
+                    *_span(node),
+                    "pallas_call constructed inside a loop — hoist the "
+                    "kernel wrapper out of the iteration",
+                )
+                continue
+            if seg in jit_statics:
+                statics, arg_names = jit_statics[seg]
+                loop_vars = _loop_targets(mod, node)
+                bindings = []
+                for i, arg in enumerate(node.args):
+                    if i < len(arg_names) and arg_names[i] in statics:
+                        bindings.append((arg_names[i], arg))
+                for kw in node.keywords:
+                    if kw.arg in statics:
+                        bindings.append((kw.arg, kw.value))
+                for pname, val in bindings:
+                    if isinstance(val, _UNHASHABLE_LITERALS):
+                        yield (
+                            *_span(node),
+                            f"unhashable literal passed for static arg "
+                            f"'{pname}' of jit function '{seg}' — jit "
+                            "static args must be hashable (use a tuple)",
+                        )
+                    elif isinstance(val, ast.Name) and val.id in loop_vars:
+                        yield (
+                            *_span(node),
+                            f"loop variable '{val.id}' passed for static "
+                            f"arg '{pname}' of jit function '{seg}' — "
+                            "one fresh XLA compile per iteration",
+                        )
+
+    @staticmethod
+    def _in_decorator(mod: SourceModule, node: ast.AST) -> bool:
+        child = node
+        for a in mod.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                if child in a.decorator_list or any(
+                    child is d or child in ast.walk(d) for d in a.decorator_list
+                ):
+                    return True
+            child = a
+        return False
+
+
+# ---------------------------------------------------------------------------
+# 4. unguarded-shared-state
+# ---------------------------------------------------------------------------
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_MUTABLE_CTORS = {
+    "dict", "list", "set", "deque", "defaultdict", "OrderedDict", "WeakSet",
+    "WeakValueDictionary", "WeakKeyDictionary", "Counter",
+}
+_MUTATING_METHODS = {
+    "append", "extend", "insert", "add", "update", "setdefault", "pop",
+    "popitem", "remove", "discard", "clear", "appendleft", "popleft",
+}
+
+
+class UnguardedSharedState(Rule):
+    name = "unguarded-shared-state"
+    default_severity = "error"
+
+    def check(self, mod: SourceModule, cfg):
+        if not _under_prefix(mod.relpath, cfg.shared_state_modules):
+            return
+        mutables, locks = self._module_state(mod)
+        # names rebound via `global` anywhere count as shared scalars
+        global_names: set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Global):
+                global_names.update(node.names)
+        shared = mutables | global_names
+        if not shared:
+            return
+        for fn in [
+            n
+            for n in ast.walk(mod.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]:
+            fn_globals = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    fn_globals.update(node.names)
+            for node in ast.walk(fn):
+                hit = self._write_target(node, shared, mutables, fn_globals)
+                if hit is None:
+                    continue
+                name, verb = hit
+                if self._under_lock(mod, node, locks):
+                    continue
+                lock_hint = (
+                    f"hold one of {sorted(locks)}"
+                    if locks
+                    else "register a module lock and hold it"
+                )
+                yield (
+                    *_span(node),
+                    f"module-level shared state '{name}' {verb} in "
+                    f"'{fn.name}' outside any registered lock — "
+                    f"{lock_hint} around the write",
+                )
+
+    @staticmethod
+    def _module_state(mod: SourceModule):
+        """(mutable names, lock names) assigned at module top level."""
+        mutables: set[str] = set()
+        locks: set[str] = set()
+        for stmt in mod.tree.body:
+            targets = []
+            value = None
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets, value = [stmt.target], stmt.value
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if isinstance(value, (ast.Dict, ast.List, ast.Set)):
+                    mutables.add(t.id)
+                elif isinstance(value, ast.Call):
+                    seg = last_segment(dotted_name(value.func))
+                    if seg in _LOCK_CTORS:
+                        locks.add(t.id)
+                    elif seg in _MUTABLE_CTORS:
+                        mutables.add(t.id)
+        return mutables, locks
+
+    @staticmethod
+    def _write_target(node, shared, mutables, fn_globals):
+        """(name, verb) when ``node`` writes a shared module name."""
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                # rebinding a global-declared name
+                if isinstance(t, ast.Name) and t.id in fn_globals and t.id in shared:
+                    return t.id, "rebound"
+                # container element store: m[...] = / m.attr =
+                base = t
+                while isinstance(base, (ast.Subscript, ast.Attribute)):
+                    base = base.value
+                if (
+                    isinstance(base, ast.Name)
+                    and base.id in mutables
+                    and base is not t
+                ):
+                    return base.id, "mutated (element store)"
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                base = t
+                while isinstance(base, (ast.Subscript, ast.Attribute)):
+                    base = base.value
+                if isinstance(base, ast.Name) and base.id in mutables and base is not t:
+                    return base.id, "mutated (del)"
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _MUTATING_METHODS
+                and isinstance(f.value, ast.Name)
+                and f.value.id in mutables
+            ):
+                return f.value.id, f"mutated (.{f.attr})"
+        return None
+
+    @staticmethod
+    def _under_lock(mod: SourceModule, node, locks) -> bool:
+        if not locks:
+            return False
+        for a in mod.ancestors(node):
+            if isinstance(a, (ast.With, ast.AsyncWith)):
+                for item in a.items:
+                    for n in ast.walk(item.context_expr):
+                        if isinstance(n, ast.Name) and n.id in locks:
+                            return True
+                        if isinstance(n, ast.Attribute) and n.attr in locks:
+                            return True
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+        return False
+
+
+# ---------------------------------------------------------------------------
+# 5. broad-except  +  6. bare-print
+# ---------------------------------------------------------------------------
+
+_BROAD_TYPES = {"Exception", "BaseException"}
+_RERAISE_EQUIVALENTS = {"skip", "xfail", "fail", "exit"}  # pytest helpers raise
+
+
+class BroadExcept(Rule):
+    name = "broad-except"
+    default_severity = "error"
+
+    def check(self, mod: SourceModule, cfg):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = node.type is None or self._is_broad(node.type)
+            if not broad:
+                continue
+            if self._reraises(node):
+                continue
+            what = (
+                "bare 'except:'"
+                if node.type is None
+                else f"'except {last_segment(dotted_name(node.type)) or 'Exception'}'"
+            )
+            yield (
+                node.lineno,
+                node.lineno,
+                f"{what} swallows every failure mode — narrow the "
+                "exception types, or re-raise after telemetry",
+            )
+
+    @staticmethod
+    def _is_broad(type_node) -> bool:
+        nodes = (
+            type_node.elts if isinstance(type_node, ast.Tuple) else [type_node]
+        )
+        return any(
+            last_segment(dotted_name(n)) in _BROAD_TYPES for n in nodes
+        )
+
+    @staticmethod
+    def _reraises(handler: ast.ExceptHandler) -> bool:
+        for n in ast.walk(handler):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Call):
+                seg = last_segment(dotted_name(n.func))
+                if seg in _RERAISE_EQUIVALENTS:
+                    return True
+        return False
+
+
+class BarePrint(Rule):
+    name = "bare-print"
+    default_severity = "error"
+
+    def check(self, mod: SourceModule, cfg):
+        if not _under_prefix(mod.relpath, cfg.print_scope):
+            return
+        if mod.relpath in cfg.print_allowed:
+            return
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                yield (
+                    *_span(node),
+                    "bare print() telemetry in a crawl-path module — "
+                    "use fuzzyheavyhitters_tpu.obs.emit (stdout is a "
+                    "program-output channel)",
+                )
+
+
+ALL_RULES: tuple[Rule, ...] = (
+    HostSyncInHotLoop(),
+    SecretToSink(),
+    RecompileChurn(),
+    UnguardedSharedState(),
+    BroadExcept(),
+    BarePrint(),
+)
+
+RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -46,7 +46,9 @@ def _load():
                     timeout=120,
                 )
                 os.replace(tmp, _LIB)
-            except Exception:
+            except (OSError, subprocess.SubprocessError):
+                # no g++ / compile error / timeout: the NumPy fallback
+                # serves every caller — anything else should surface
                 _build_failed = True
                 return None
             finally:

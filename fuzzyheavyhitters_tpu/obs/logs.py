@@ -33,7 +33,9 @@ import time
 
 _SEVERITIES = {"debug": 10, "info": 20, "warn": 30, "error": 40}
 
-_lock = threading.Lock()
+# RLock: emit() holds it across _resolve_stream, which takes it again
+# around the _opened mutations so it is ALSO safe called standalone
+_lock = threading.RLock()
 _cfg = {
     "fmt": os.environ.get("FHH_LOG_FORMAT", "human"),
     "stream": os.environ.get("FHH_LOG_STREAM", "stderr"),
@@ -64,25 +66,26 @@ def _resolve_stream():
     if s == "stdout":
         return sys.stdout
     if isinstance(s, str):  # file path: open once, append, keep open
-        if _opened["path"] != s:
-            if _opened["file"] is not None:
+        with _lock:  # reentrant from emit(); guards _opened standalone too
+            if _opened["path"] != s:
+                if _opened["file"] is not None:
+                    try:
+                        _opened["file"].close()
+                    except OSError:
+                        pass
+                # record the attempt BEFORE opening: a bad path must degrade
+                # to stderr once, not re-raise out of every emit — a telemetry
+                # knob misconfiguration may never take down the crawl
+                _opened["path"] = s
                 try:
-                    _opened["file"].close()
-                except OSError:
-                    pass
-            # record the attempt BEFORE opening: a bad path must degrade
-            # to stderr once, not re-raise out of every emit — a telemetry
-            # knob misconfiguration may never take down the crawl
-            _opened["path"] = s
-            try:
-                _opened["file"] = open(s, "a", buffering=1)
-            except OSError as e:
-                _opened["file"] = None
-                sys.stderr.write(
-                    f"[fhh] cannot open log stream {s!r} ({e}); "
-                    "falling back to stderr\n"
-                )
-        return _opened["file"] if _opened["file"] is not None else sys.stderr
+                    _opened["file"] = open(s, "a", buffering=1)
+                except OSError as e:
+                    _opened["file"] = None
+                    sys.stderr.write(
+                        f"[fhh] cannot open log stream {s!r} ({e}); "
+                        "falling back to stderr\n"
+                    )
+            return _opened["file"] if _opened["file"] is not None else sys.stderr
     return s  # a file-like object (tests)
 
 

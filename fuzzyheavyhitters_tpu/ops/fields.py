@@ -175,7 +175,38 @@ class FE62:
 
     @classmethod
     def to_numpy_ints(cls, v) -> np.ndarray:
-        return np.asarray(jax.jit(cls.canon)(v), dtype=np.uint64)
+        # cls.canon is already jitted at import (_jit_field_methods);
+        # re-wrapping it here built a fresh compile cache per call
+        return np.asarray(cls.canon(v), dtype=np.uint64)
+
+    # -- host (NumPy) twins: bit-identical math with no device round trip,
+    # for per-level host-side derivations (the shared wire masks in
+    # protocol/rpc.py) where a device sample + fetch costs a tunnel RTT --
+
+    @staticmethod
+    def _np_bit_reduce(v: np.ndarray) -> np.ndarray:
+        excess = v >> np.uint64(62)
+        low = v & np.uint64(_M62)
+        return low + excess + (excess << np.uint64(30))
+
+    @classmethod
+    def np_add(cls, a, b) -> np.ndarray:
+        return cls._np_bit_reduce(
+            np.asarray(a, np.uint64) + np.asarray(b, np.uint64)
+        )
+
+    @classmethod
+    def np_sample(cls, words) -> np.ndarray:
+        """Host twin of :meth:`sample` (same bit-for-bit mapping)."""
+        w = np.asarray(words, np.uint64)
+        lo = (w[..., 0] | (w[..., 1] << np.uint64(32))) & np.uint64(_M62)
+        hi = w[..., 2] | (w[..., 3] << np.uint64(32))
+        mask32 = np.uint64(0xFFFFFFFF)
+        h0, h1 = hi & mask32, hi >> np.uint64(32)
+        r = cls._np_bit_reduce(lo + hi)
+        r = cls._np_bit_reduce(r + (h0 << np.uint64(30)))
+        r = cls._np_bit_reduce(r + (h1 << np.uint64(30)))
+        return cls._np_bit_reduce(r + h1)
 
 
 _P255 = (1 << 255) - 19
@@ -352,6 +383,60 @@ class F255:
         limbs = cls._sub_p_if(limbs, cls._geq_p(limbs))
         return limbs
 
+    # -- host (NumPy) twins (see FE62: per-level host derivations must not
+    # cost a device round trip) --------------------------------------------
+
+    @classmethod
+    def _np_geq_p(cls, limbs: np.ndarray) -> np.ndarray:
+        ge = np.ones(limbs.shape[:-1], bool)
+        decided = np.zeros(limbs.shape[:-1], bool)
+        for i in reversed(range(8)):
+            li = limbs[..., i]
+            pi = np.uint32(_P255_LIMBS[i])
+            gt = ~decided & (li > pi)
+            lt = ~decided & (li < pi)
+            ge = np.where(lt, False, np.where(gt, True, ge))
+            decided = decided | gt | lt
+        return ge
+
+    @classmethod
+    def _np_sub_p_if(cls, limbs: np.ndarray, cond: np.ndarray) -> np.ndarray:
+        p = np.array(_P255_LIMBS, np.uint64)
+        out = np.zeros(limbs.shape, np.uint64)
+        borrow = np.zeros(limbs.shape[:-1], np.uint64)
+        for i in range(8):
+            d = limbs[..., i].astype(np.uint64) - p[i] - borrow
+            out[..., i] = d & np.uint64(0xFFFFFFFF)
+            borrow = (d >> np.uint64(63)) & np.uint64(1)
+        return np.where(cond[..., None], out.astype(np.uint32), limbs)
+
+    @staticmethod
+    def _np_carry_chain(limbs64: np.ndarray):
+        out = np.zeros(limbs64.shape, np.uint64)
+        carry = np.zeros(limbs64.shape[:-1], np.uint64)
+        for i in range(8):
+            s = limbs64[..., i] + carry
+            out[..., i] = s & np.uint64(0xFFFFFFFF)
+            carry = s >> np.uint64(32)
+        return out, carry
+
+    @classmethod
+    def np_add(cls, a, b) -> np.ndarray:
+        s64 = np.asarray(a, np.uint32).astype(np.uint64) + np.asarray(
+            b, np.uint32
+        ).astype(np.uint64)
+        limbs, carry = cls._np_carry_chain(s64)
+        limbs[..., 0] += carry * np.uint64(38)  # 2^256 === 38 (mod p)
+        limbs = cls._np_carry_chain(limbs)[0].astype(np.uint32)
+        return cls._np_sub_p_if(limbs, cls._np_geq_p(limbs))
+
+    @classmethod
+    def np_sample(cls, words) -> np.ndarray:
+        """Host twin of :meth:`sample` (same bit-for-bit mapping)."""
+        limbs = np.asarray(words, np.uint32)
+        limbs = cls._np_sub_p_if(limbs, cls._np_geq_p(limbs))
+        return cls._np_sub_p_if(limbs, cls._np_geq_p(limbs))
+
     @classmethod
     def sum(cls, v, *, axis):
         """Modular sum along ``axis`` via pairwise tree reduction."""
@@ -506,7 +591,8 @@ class U63:
 
     @classmethod
     def to_numpy_ints(cls, v) -> np.ndarray:
-        return np.asarray(jax.jit(cls.canon)(v), dtype=np.uint64)
+        # cls.canon is already jitted at import (_jit_field_methods)
+        return np.asarray(cls.canon(v), dtype=np.uint64)
 
 
 class Dummy:
@@ -549,10 +635,12 @@ def _jit_field_methods():
         (U63, ["canon", "add", "neg", "sub", "mul", "eq", "sample"]),
     ):
         for name in names:
+            # fhh-lint: disable=recompile-churn (runs once, at import)
             setattr(klass, name, staticmethod(jax.jit(getattr(klass, name))))
         setattr(
             klass,
             "sum",
+            # fhh-lint: disable=recompile-churn (runs once, at import)
             staticmethod(jax.jit(getattr(klass, "sum"), static_argnames=("axis",))),
         )
 

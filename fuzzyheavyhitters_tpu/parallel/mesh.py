@@ -261,6 +261,7 @@ class MeshRunner:
             sec_seed = np.asarray(sec_seed)
         self._sec = {}
         for g, (s_bits, seeds0, seeds1, chosen) in enumerate(host_mats):
+            # fhh-lint: disable=host-sync-in-hot-loop (one-time session setup)
             s_bits = np.asarray(s_bits)
             zb = np.zeros_like(s_bits)
             rows = lambda a_g, a_e: np.stack([a_g, a_e] if g == 0 else [a_e, a_g])
@@ -293,6 +294,7 @@ class MeshRunner:
             f = collect.tree_init(keys, root_bucket, planar=False)
             return jax.tree.map(lambda a: a[None], f)
 
+        # fhh-lint: disable=recompile-churn (setup-time factory: built once per mesh)
         self._init_fn = jax.jit(
             jax.shard_map(init_body, mesh=mesh, in_specs=(kspec,), out_specs=fspec)
         )
@@ -318,6 +320,7 @@ class MeshRunner:
                     return cnt
                 return cnt, jax.tree.map(lambda a: a[None], children)
 
+            # fhh-lint: disable=recompile-churn (setup-time factory: built once per mesh)
             return jax.jit(
                 jax.shard_map(
                     counts_body,
@@ -335,6 +338,7 @@ class MeshRunner:
             new = collect._advance_children_jit(ch, parent, pat_bits, n_alive)
             return jax.tree.map(lambda a: a[None], new)
 
+        # fhh-lint: disable=recompile-churn (setup-time factory: built once per mesh)
         self._advance_fn = jax.jit(
             jax.shard_map(
                 advc_body,
@@ -472,6 +476,7 @@ class MeshRunner:
                 return allsh
             return allsh, jax.tree.map(lambda a: a[None], children)
 
+        # fhh-lint: disable=recompile-churn (setup-time factory: built once per mesh)
         fn = jax.jit(
             jax.shard_map(
                 body,

@@ -50,7 +50,7 @@ frontier plus the child cache plus the packed-bit tensor, independent of
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import NamedTuple
 
 import jax
@@ -194,10 +194,14 @@ def _bit_positions(d: int):
     return (j * 4 + s * 2 + r).astype(np.uint32)  # [d, 2, 2]
 
 
+@lru_cache(maxsize=None)
 def pattern_masks(d: int) -> np.ndarray:
     """uint32[2^d] — for child pattern c, the packed-bit positions that a
     membership test must compare: both sides of every dim, at direction
-    ``(c >> j) & 1`` (child order: ref lib.rs:125-129)."""
+    ``(c >> j) & 1`` (child order: ref lib.rs:125-129).
+
+    Cached (and returned read-only): every crawl level on every server
+    asked for the same table, rebuilding a 2^d Python loop per level."""
     assert d <= MAX_DIMS
     pos = _bit_positions(d)
     masks = []
@@ -207,7 +211,9 @@ def pattern_masks(d: int) -> np.ndarray:
             r = (c >> j) & 1
             m |= (np.uint32(1) << pos[j, 0, r]) | (np.uint32(1) << pos[j, 1, r])
         masks.append(m)
-    return np.array(masks, dtype=np.uint32)
+    out = np.array(masks, dtype=np.uint32)
+    out.setflags(write=False)
+    return out
 
 
 def expand_share_bits(

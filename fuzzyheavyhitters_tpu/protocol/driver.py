@@ -49,9 +49,11 @@ def cw_window(keys: IbDcfKeyBatch, lo: int, hi: int):
     slice is one contiguous 13 MB view — slicing the natural
     ``[..., W, words]`` layout instead was a strided gather over the
     whole window and cost ~2 s/level on chip."""
-    take = lambda a: jax.device_put(
-        np.ascontiguousarray(np.moveaxis(np.asarray(a)[..., lo:hi, :], -2, 0))
-    )
+    def take(a):
+        # fhh-lint: disable=host-sync-in-hot-loop (keys are host-resident
+        # by design in streaming mode; this IS the windowed upload)
+        win = np.asarray(a)[..., lo:hi, :]
+        return jax.device_put(np.ascontiguousarray(np.moveaxis(win, -2, 0)))
     return take(keys.cw_seed), take(keys.cw_bits), take(keys.cw_y_bits)
 
 
@@ -212,10 +214,13 @@ class Leader:
                     p0,
                     p1,
                     masks,
-                    np.asarray(self.server0.alive_keys),
+                    self.server0.alive_keys,  # host bool[N] as-is
                     self.server0.frontier.alive,
                 )
                 self.obs.count("device_fetches")
+                # the ONE deliberate per-level readback: the threshold
+                # decision and prune bookkeeping are leader/host logic
+                # fhh-lint: disable=host-sync-in-hot-loop (counted above)
                 counts = np.asarray(counts)  # [F, 2^d]
 
                 thresh = max(1, int(threshold * nreqs))  # ref: leader.rs:193-194

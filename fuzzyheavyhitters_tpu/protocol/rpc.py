@@ -117,11 +117,15 @@ def _mask_words(level: int, n: int, blocks_for: int) -> np.ndarray:
 
 
 def mask_fe62(level: int, n: int) -> np.ndarray:
-    return np.asarray(FE62.sample(_mask_words(level, n, 4)))
+    # host twin of FE62.sample: the device version sampled ~KB of masks on
+    # the accelerator and fetched them back — one tunnel RTT per level for
+    # work NumPy does in microseconds (flagged by fhh-lint
+    # host-sync-in-hot-loop, round 6)
+    return FE62.np_sample(_mask_words(level, n, 4))
 
 
 def mask_f255(level: int, n: int) -> np.ndarray:
-    return np.asarray(F255.sample(_mask_words(level, n, 8)))
+    return F255.np_sample(_mask_words(level, n, 8))
 
 
 # ---------------------------------------------------------------------------
@@ -317,11 +321,11 @@ class CollectorServer:
             1,
             self.cfg.sketch_batch_size_last if last else self.cfg.sketch_batch_size,
         )
-        ok = np.empty(n, bool)
+        ok_parts = []  # per-batch device verdicts; ONE fetch after the loop
         for lo in range(0, n, bs):
             sl = slice(lo, min(lo + bs, n))
             ks = jax.tree.map(lambda a: a[sl], self._sketch)
-            n_sl = ok[sl].shape[0]
+            n_sl = min(lo + bs, n) - lo
             r, rands = sketchmod.shared_r_stream(
                 fld, self._sketch_seed, level, m_nodes, n_sl * d
             )
@@ -338,17 +342,27 @@ class CollectorServer:
             mk2 = jnp.expand_dims(jnp.asarray(mk2), 1)
             state = sketchmod.mul_state(fld, out, mk, mk2, trip)
             # one stacked array = one device fetch + one wire message
+            # fhh-lint: disable=host-sync-in-hot-loop (wire fetch: the
+            # exchange below needs host bytes; one fetch per round trip)
             cs = np.asarray(jnp.stack(mpc.cor_share(fld, state)))
             peer_cs = await self._swap(cs)
             pair_cs = (cs, peer_cs) if self.server_id == 0 else (peer_cs, cs)
             opened = mpc.cor(fld, (pair_cs[0][0], pair_cs[0][1]),
                              (pair_cs[1][0], pair_cs[1][1]))
+            # fhh-lint: disable=host-sync-in-hot-loop (wire fetch, as above)
             o = np.asarray(
                 mpc.out_share(fld, bool(self.server_id), state, opened)
             )
             peer_o = await self._swap(o)
-            ok_nd = np.asarray(mpc.verify(fld, o, peer_o))  # [n_sl, d]
-            ok[sl] = ok_nd.all(axis=1)
+            # verdicts stay ON DEVICE inside the loop; fetching per batch
+            # cost one round trip per `bs` clients (fhh-lint caught it)
+            ok_parts.append(mpc.verify(fld, o, peer_o))  # [n_sl, d]
+        if ok_parts:
+            # fhh-lint: disable=host-sync-in-hot-loop (one post-loop readback)
+            ok_nd = np.asarray(jnp.concatenate(ok_parts, axis=0))  # [n, d]
+            ok = ok_nd.all(axis=1)
+        else:  # n == 0: nothing to verify
+            ok = np.ones(n, bool)
         if level != 0:
             # one-shot: each stored depth's triples open exactly once (a
             # repeat would be a same-challenge replay at best — reject it
@@ -375,6 +389,7 @@ class CollectorServer:
         fld = F255 if last else FE62
         k = self._sketch.key  # batch [N, d]
         d = k.root_seed.shape[1]
+        # fhh-lint: disable=host-sync-in-hot-loop (wire input: host numpy)
         parent = np.asarray(parent)
         st = jax.tree.map(lambda a: a[parent], self._sketch_states)
         direction = jnp.asarray(pat_bits, bool)[:, None, :]  # [F, 1, d]
@@ -561,11 +576,10 @@ class CollectorServer:
         # server.rs:331-332).  Secrecy comes from secure_exchange above.
         r = mask_fe62(level, counts.size).reshape(counts.shape)
         if self.server_id == 0:
-            # FE62.add is a jnp op: fetch off-loop like every other
-            # device->host transfer in the data plane (see _fetch)
-            return await _fetch(
-                FE62.add(counts.astype(np.uint64), r), self.obs, level=level
-            )
+            # counts are already host-side; the mask add stays host-side
+            # too (FE62.np_add) — the old device add + _fetch cost a full
+            # tunnel RTT per level for a ~KB elementwise op
+            return FE62.np_add(counts.astype(np.uint64), r)
         return r
 
     async def tree_crawl_last(self, req) -> np.ndarray:
@@ -583,7 +597,8 @@ class CollectorServer:
             if self.server_id == 0:
                 c = np.zeros(counts.shape + (8,), np.uint32)
                 c[..., 0] = counts
-                shares = await _fetch(F255.add(c, r), self.obs, level=level)
+                # host-side limb add (F255.np_add): no device round trip
+                shares = F255.np_add(c, r)
             else:
                 shares = r
         self._last_shares = shares
@@ -594,7 +609,9 @@ class CollectorServer:
         (ref: rpc.rs:63 tree_prune + collect.rs:918-929).  The sketch DPF
         states advance with the same survivor table."""
         level = req["level"]
+        # fhh-lint: disable=host-sync-in-hot-loop (wire input: host numpy)
         parent = np.asarray(req["parent_idx"], np.int32)
+        # fhh-lint: disable=host-sync-in-hot-loop (wire input: host numpy)
         pat_bits = np.asarray(req["pattern_bits"], bool)
         n_alive = int(req["n_alive"])
         if self._children is not None:  # cache from this level's crawl
@@ -619,7 +636,9 @@ class CollectorServer:
         if self._last_shares is None:  # protocol-boundary check: no assert
             raise RuntimeError("tree_prune_last called before tree_crawl_last")
         self._children = None  # leaf level: nothing advances past it
+        # fhh-lint: disable=host-sync-in-hot-loop (wire input: host numpy)
         parent = np.asarray(req["parent_idx"], np.int64)
+        # fhh-lint: disable=host-sync-in-hot-loop (wire input: host numpy)
         pattern = np.asarray(req["pattern_bits"], bool)
         n_alive = int(req["n_alive"])
         d = pattern.shape[1]
@@ -628,6 +647,7 @@ class CollectorServer:
         if self._sketch is not None:
             L = self.keys.cw_seed.shape[-2]
             self._advance_sketch(
+                # fhh-lint: disable=host-sync-in-hot-loop (wire input)
                 L - 1, np.asarray(req["parent_idx"], np.int32), pattern, n_alive
             )
         self.obs.gauge(
@@ -673,7 +693,14 @@ class CollectorServer:
                 else:
                     async with self._verb_lock:
                         resp = await getattr(self, verb)(req)
-            except Exception as e:  # surface to the caller, don't hang it
+            # fhh-lint: disable=broad-except (RPC boundary: EVERY failure
+            # mode must surface to the caller as an error response — a
+            # narrowed list would hang the leader on the first unlisted one)
+            except Exception as e:
+                obs.emit(
+                    "verb.error", severity="warn", server=self.server_id,
+                    verb=verb, error=f"{type(e).__name__}: {e}",
+                )
                 resp = {"__error__": f"{type(e).__name__}: {e}"}
             try:
                 async with write_lock:
@@ -886,6 +913,25 @@ class CollectorClient:
                 if not fut.done():
                     fut.set_exception(ConnectionError(f"connection lost: {e!r}"))
             self._pending.clear()
+            if not isinstance(
+                e,
+                (
+                    asyncio.IncompleteReadError,  # clean peer close / EOF
+                    ConnectionError,
+                    EOFError,
+                    OSError,
+                    pickle.UnpicklingError,  # corrupt frame = transport loss
+                ),
+            ):
+                # anything else is a BUG in this client, not a transport
+                # death.  Emit it NOW — nothing awaits the reader task, so
+                # a bare re-raise would sit unretrieved until GC — then
+                # re-raise for any future consumer of the task result.
+                obs.emit(
+                    "client.reader_error", severity="error",
+                    error=f"{type(e).__name__}: {e}",
+                )
+                raise
 
     async def call(self, verb: str, req=None):
         if getattr(self, "_dead", None) is not None:

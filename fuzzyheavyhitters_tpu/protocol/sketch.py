@@ -332,7 +332,9 @@ def verify_level(
         sl = slice(lo, min(lo + sketch_batch_size, N))
         ks0 = jax.tree.map(lambda a: a[sl], sk0)
         ks1 = jax.tree.map(lambda a: a[sl], sk1)
-        n_sl = np.asarray(ks0.key.root_seed).shape[0]
+        # .shape needs no materialization — np.asarray here copied the
+        # whole seed batch to host once per verification batch
+        n_sl = ks0.key.root_seed.shape[0]
         # draw the r vector at the full-tree width and slice: the stream
         # program then has one shape for every level (and both servers
         # still derive identical values — same function, same args)

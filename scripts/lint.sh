@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# fhh-lint strict run with a machine-readable artifact.
+#
+# Usage: scripts/lint.sh [artifact.json]
+#   - exits 0 iff the tree has ZERO non-baselined findings (any severity)
+#   - writes the JSON report to $1 (default: lint_report.json)
+#
+# The same check runs inside tier-1 via tests/test_analysis.py's self-lint
+# test; this script is the standalone/CI entry point.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+artifact="${1:-lint_report.json}"
+
+rc=0
+python -m fuzzyheavyhitters_tpu.analysis \
+    fuzzyheavyhitters_tpu tests \
+    --strict --format json > "$artifact" || rc=$?
+
+python - "$artifact" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+print(
+    f"fhh-lint: {len(doc['findings'])} new, "
+    f"{doc['baselined']} baselined, "
+    f"{len(doc['stale_baseline'])} stale baseline entries "
+    f"-> {sys.argv[1]}"
+)
+EOF
+exit $rc

@@ -51,6 +51,15 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def has_tpu() -> bool:
+    """Shared TPU probe (the pallas test modules and the retry hook all
+    need the same answer — one copy, not three)."""
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except RuntimeError:  # no backend initialized -> not a TPU session
+        return False
+
+
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_call(item):
     """Retry ``tpu_retry``-marked tests once when running against the remote
@@ -62,15 +71,13 @@ def pytest_runtest_call(item):
     outcome = yield
     if outcome.excinfo is None or item.get_closest_marker("tpu_retry") is None:
         return
-    try:
-        on_tpu = jax.devices()[0].platform == "tpu"
-    except Exception:
-        on_tpu = False
-    if not on_tpu:
+    if not has_tpu():
         return
     first_err = repr(outcome.excinfo[1])[:300]
     try:
         item.runtest()
+    # fhh-lint: disable=broad-except (retry harness: must catch whatever
+    # exception type the retried test raises; original error is re-reported)
     except Exception:
         return  # failed twice: deterministic — let the original error stand
     outcome.force_result(None)

@@ -1,0 +1,672 @@
+"""fhh-lint: rule fixtures, suppression semantics, baseline machinery,
+CLI plumbing, and the repo self-lint.
+
+Each rule gets positive (seeded violation detected) and negative (idiomatic
+clean code passes) fixtures; the self-lint test at the bottom is the tier-1
+enforcement point: the tree must be clean at default severity under the
+checked-in baseline, with no pytest marker so the driver's default
+invocation always runs it.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from fuzzyheavyhitters_tpu.analysis import (
+    ALL_RULES,
+    LintConfig,
+    apply_baseline,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    load_config,
+    write_baseline,
+)
+from fuzzyheavyhitters_tpu.analysis.rules import RULES_BY_NAME
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(src, relpath="fuzzyheavyhitters_tpu/protocol/fake.py", cfg=None,
+          rule=None):
+    cfg = cfg or LintConfig()
+    rules = [RULES_BY_NAME[rule]] if rule else None
+    return lint_source(textwrap.dedent(src), relpath, cfg, rules)
+
+
+def _names(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# rule: host-sync-in-hot-loop
+# ---------------------------------------------------------------------------
+
+
+def test_host_sync_in_loop_detected():
+    src = """
+    import numpy as np
+    def run(levels, x):
+        for level in levels:
+            y = np.asarray(x)  # device fetch per level
+        return y
+    """
+    fs = _lint(src, rule="host-sync-in-hot-loop")
+    assert _names(fs) == ["host-sync-in-hot-loop"]
+    assert fs[0].line == 5
+
+
+def test_host_sync_via_hot_root_transitive():
+    src = """
+    import numpy as np
+    def helper(x):
+        return np.asarray(x)
+    def tree_crawl(x):
+        return helper(x)
+    """
+    fs = _lint(src, rule="host-sync-in-hot-loop")
+    assert len(fs) == 1 and "helper" in fs[0].message
+
+
+def test_host_sync_item_and_block_until_ready():
+    src = """
+    def run_level(x):
+        a = x.item()
+        x.block_until_ready()
+        return a
+    """
+    assert len(_lint(src, rule="host-sync-in-hot-loop")) == 2
+
+
+def test_host_sync_cast_inside_jit():
+    src = """
+    import jax
+    @jax.jit
+    def f(x):
+        return bool(x.sum())
+    """
+    fs = _lint(src, "some/other/module.py", rule="host-sync-in-hot-loop")
+    assert len(fs) == 1 and "jit-compiled" in fs[0].message
+
+
+def test_host_sync_clean_cases():
+    src = """
+    import numpy as np
+    import jax.numpy as jnp
+    def setup(x):
+        return np.asarray(x)  # not hot: no loop, not a hot root
+    def run_level(x):
+        return jnp.asarray(x)  # device-side, never flagged
+    def other(p):
+        return bool(p)  # plain cast outside jit
+    """
+    assert _lint(src, rule="host-sync-in-hot-loop") == []
+
+
+def test_host_sync_not_hot_outside_hot_modules():
+    src = """
+    import numpy as np
+    def f(xs):
+        for x in xs:
+            y = np.asarray(x)
+        return y
+    """
+    assert _lint(src, "fuzzyheavyhitters_tpu/workloads/w.py",
+                 rule="host-sync-in-hot-loop") == []
+
+
+# ---------------------------------------------------------------------------
+# rule: secret-to-sink
+# ---------------------------------------------------------------------------
+
+
+def test_secret_to_emit_detected():
+    src = """
+    from .. import obs
+    def f(gc_seed):
+        obs.emit("level.done", seed=gc_seed)
+    """
+    fs = _lint(src, rule="secret-to-sink")
+    assert len(fs) == 1 and "gc_seed" in fs[0].message
+
+
+def test_secret_to_print_and_raise_detected():
+    src = """
+    def f(self):
+        print(self.cw_seed)
+        raise ValueError(f"bad key: {self._sec_seed}")
+    """
+    fs = _lint(src, rule="secret-to-sink")
+    assert len(fs) == 2
+
+
+def test_secret_sink_clean_cases():
+    src = """
+    from .. import obs
+    def f(level, seconds, seed_len):
+        obs.emit("level.done", level=level, fss_s=seconds)
+        raise ValueError(f"bad level {level}")
+    """
+    # NB 'seed_len' segments are ('seed','len') — present but unused: only
+    # flow INTO a sink counts
+    assert _lint(src, rule="secret-to-sink") == []
+
+
+def test_secret_kwarg_name_counts_as_flow():
+    src = """
+    def f(emit, x):
+        emit("evt", mac_key=x)
+    """
+    fs = _lint(src, rule="secret-to-sink")
+    assert len(fs) == 1
+
+
+# ---------------------------------------------------------------------------
+# rule: recompile-churn
+# ---------------------------------------------------------------------------
+
+
+def test_jit_wrapper_in_function_detected():
+    src = """
+    import jax, numpy as np
+    def to_ints(v):
+        return np.asarray(jax.jit(canon)(v))
+    """
+    fs = _lint(src, rule="recompile-churn")
+    assert len(fs) == 1 and "hoist" in fs[0].message
+
+
+def test_jit_wrapper_at_module_level_clean():
+    src = """
+    import jax
+    def canon(v):
+        return v
+    canon_jit = jax.jit(canon)
+    @jax.jit
+    def g(x):
+        return x
+    """
+    assert _lint(src, rule="recompile-churn") == []
+
+
+def test_static_arg_unhashable_literal_detected():
+    src = """
+    import jax
+    from functools import partial
+    @partial(jax.jit, static_argnames=("shape",))
+    def f(x, shape):
+        return x
+    def caller(x):
+        return f(x, shape=[1, 2])
+    """
+    fs = _lint(src, rule="recompile-churn")
+    assert len(fs) == 1 and "unhashable" in fs[0].message
+
+
+def test_static_arg_loop_variable_detected():
+    src = """
+    import jax
+    from functools import partial
+    @partial(jax.jit, static_argnums=(1,))
+    def f(x, width):
+        return x
+    def caller(x, widths):
+        for w in widths:
+            x = f(x, w)
+        return x
+    """
+    fs = _lint(src, rule="recompile-churn")
+    assert len(fs) == 1 and "loop variable" in fs[0].message
+
+
+def test_static_arg_clean_call():
+    src = """
+    import jax
+    from functools import partial
+    @partial(jax.jit, static_argnames=("width",))
+    def f(x, width):
+        return x
+    def caller(x):
+        return f(x, width=8)
+    """
+    assert _lint(src, rule="recompile-churn") == []
+
+
+# ---------------------------------------------------------------------------
+# rule: unguarded-shared-state
+# ---------------------------------------------------------------------------
+
+_SHARED_PATH = "fuzzyheavyhitters_tpu/obs/fake.py"
+
+
+def test_unguarded_write_detected():
+    src = """
+    import threading
+    _lock = threading.Lock()
+    _cache = {}
+    def put(k, v):
+        _cache[k] = v
+    """
+    fs = _lint(src, _SHARED_PATH, rule="unguarded-shared-state")
+    assert len(fs) == 1 and "_cache" in fs[0].message
+
+
+def test_unguarded_global_rebind_and_method_detected():
+    src = """
+    import threading
+    _lock = threading.Lock()
+    _items = []
+    _count = 0
+    def add(v):
+        global _count
+        _count += 1
+        _items.append(v)
+    """
+    fs = _lint(src, _SHARED_PATH, rule="unguarded-shared-state")
+    assert len(fs) == 2
+
+
+def test_locked_write_clean():
+    src = """
+    import threading
+    _lock = threading.RLock()
+    _cache = {}
+    _n = 0
+    def put(k, v):
+        global _n
+        with _lock:
+            _cache[k] = v
+            _n += 1
+    """
+    assert _lint(src, _SHARED_PATH, rule="unguarded-shared-state") == []
+
+
+def test_shared_state_rule_scoped_to_configured_modules():
+    src = """
+    _cache = {}
+    def put(k, v):
+        _cache[k] = v
+    """
+    assert _lint(src, "fuzzyheavyhitters_tpu/workloads/w.py",
+                 rule="unguarded-shared-state") == []
+
+
+# ---------------------------------------------------------------------------
+# rules: broad-except, bare-print
+# ---------------------------------------------------------------------------
+
+
+def test_broad_except_detected_and_reraise_clean():
+    src = """
+    def f():
+        try:
+            g()
+        except Exception:
+            pass
+    def g():
+        try:
+            h()
+        except:
+            return None
+    def ok():
+        try:
+            h()
+        except Exception:
+            cleanup()
+            raise
+    def ok2():
+        try:
+            h()
+        except ValueError:
+            return None
+    """
+    fs = _lint(src, rule="broad-except")
+    assert len(fs) == 2
+    assert "bare" in fs[1].message
+
+
+def test_broad_except_pytest_skip_counts_as_raise():
+    src = """
+    import pytest
+    def probe():
+        try:
+            g()
+        except Exception:
+            pytest.skip("no backend")
+    """
+    assert _lint(src, rule="broad-except") == []
+
+
+def test_bare_print_detected_and_scoped():
+    src = """
+    def f(x):
+        print("crawl done", x)
+    """
+    assert len(_lint(src, rule="bare-print")) == 1
+    # out of scope: tests and the allowlisted plot scripts
+    assert _lint(src, "tests/test_x.py", rule="bare-print") == []
+    assert _lint(
+        src,
+        "fuzzyheavyhitters_tpu/workloads/ride_austin_visualization.py",
+        rule="bare-print",
+    ) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_inline_suppression_same_line():
+    src = """
+    def f(x):
+        print(x)  # fhh-lint: disable=bare-print (demo tool)
+    """
+    assert _lint(src, rule="bare-print") == []
+
+
+def test_suppression_standalone_comment_applies_to_next_code_line():
+    src = """
+    def f(x):
+        # fhh-lint: disable=bare-print (a justification
+        # that continues over two comment lines)
+        print(x)
+    """
+    assert _lint(src, rule="bare-print") == []
+
+
+def test_suppression_is_per_rule():
+    src = """
+    def f(x):
+        print(x.cw_seed)  # fhh-lint: disable=bare-print
+    """
+    # bare-print silenced; secret-to-sink still fires
+    names = _names(_lint(src))
+    assert names == ["secret-to-sink"]
+
+
+def test_suppression_multiple_rules_one_comment():
+    src = """
+    def f(x):
+        print(x.cw_seed)  # fhh-lint: disable=bare-print,secret-to-sink
+    """
+    assert _lint(src) == []
+
+
+# ---------------------------------------------------------------------------
+# baseline semantics
+# ---------------------------------------------------------------------------
+
+_BASE_SRC = """
+def run_level(x):
+    import numpy as np
+    a = np.asarray(x)
+    b = np.asarray(x)
+    return a, b
+"""
+
+
+def _base_findings():
+    return _lint(_BASE_SRC, rule="host-sync-in-hot-loop")
+
+
+def test_baseline_absorbs_up_to_count(tmp_path):
+    fs = _base_findings()
+    assert len(fs) == 2
+    path = str(tmp_path / "b.json")
+    write_baseline(path, fs)
+    counts = load_baseline(path)
+    res = apply_baseline(fs, counts)
+    assert res.new == [] and res.absorbed == 2 and res.stale == []
+
+
+def test_baseline_growth_is_new(tmp_path):
+    fs = _base_findings()
+    path = str(tmp_path / "b.json")
+    write_baseline(path, fs[:1])  # baseline holds count=1
+    res = apply_baseline(fs, load_baseline(path))
+    assert len(res.new) == 1 and res.absorbed == 1
+    # the reported NEW finding is the later one in line order
+    assert res.new[0].line == max(f.line for f in fs)
+
+
+def test_baseline_shrink_reports_stale(tmp_path):
+    fs = _base_findings()
+    path = str(tmp_path / "b.json")
+    write_baseline(path, fs)
+    res = apply_baseline(fs[:1], load_baseline(path))
+    assert res.new == [] and res.absorbed == 1
+    assert res.stale == [
+        ("host-sync-in-hot-loop", "fuzzyheavyhitters_tpu/protocol/fake.py", 1)
+    ]
+
+
+def test_baseline_remove_via_update(tmp_path):
+    path = str(tmp_path / "b.json")
+    write_baseline(path, _base_findings())
+    write_baseline(path, [])  # burn-down complete
+    assert load_baseline(path) == {}
+
+
+def test_baseline_partial_update_keeps_unscanned_entries(tmp_path):
+    """write_baseline(keep=...) — the CLI passes entries for files outside
+    the scanned path set so a partial --update-baseline run cannot erase
+    another subtree's grandfathered findings."""
+    fs = _base_findings()  # all in fuzzyheavyhitters_tpu/protocol/fake.py
+    path = str(tmp_path / "b.json")
+    keep = {"host-sync-in-hot-loop": {"other/subtree.py": 3},
+            "recompile-churn": {"gone/now_clean.py": 0}}
+    write_baseline(path, fs, keep=keep)
+    counts = load_baseline(path)
+    assert counts["host-sync-in-hot-loop"]["other/subtree.py"] == 3
+    assert counts["host-sync-in-hot-loop"][
+        "fuzzyheavyhitters_tpu/protocol/fake.py"
+    ] == 2
+    assert "recompile-churn" not in counts  # zero-count entries dropped
+
+
+def test_baseline_stale_scoped_to_scanned_paths():
+    """A partial-scope run must not report unscanned files' baseline
+    entries as stale burn-down wins."""
+    counts = {"host-sync-in-hot-loop": {"pkg/unscanned.py": 8}}
+    res = apply_baseline([], counts, scanned={"pkg/scanned.py"})
+    assert res.stale == []
+    res = apply_baseline([], counts, scanned={"pkg/unscanned.py"})
+    assert res.stale == [("host-sync-in-hot-loop", "pkg/unscanned.py", 8)]
+
+
+def test_cli_update_baseline_drops_deleted_files_keeps_unscanned(tmp_path):
+    """Partial --update-baseline: entries for files outside the scan scope
+    survive IF the file still exists; deleted files' entries drop out."""
+    pkg = tmp_path / "pkg"
+    sub = pkg / "sub"
+    sub.mkdir(parents=True)
+    (pkg / "live.py").write_text("def f(x):\n    print(x)\n")
+    (sub / "other.py").write_text("def g(x):\n    print(x)\n")
+    base = tmp_path / "lint_baseline.json"
+    base.write_text(json.dumps({
+        "schema": "fhh-lint-baseline/1",
+        "counts": {"bare-print": {
+            "pkg/live.py": 1,          # scanned: rewritten from findings
+            "pkg/sub/other.py": 1,     # unscanned but alive: kept
+            "pkg/deleted.py": 4,       # gone from disk: dropped
+        }},
+    }))
+    cfg_toml = tmp_path / "pyproject.toml"
+    cfg_toml.write_text(
+        "[tool.fhh-lint]\nprint_scope = [\"pkg\"]\n"
+        "baseline = \"lint_baseline.json\"\n"
+    )
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "fuzzyheavyhitters_tpu.analysis",
+         "pkg/live.py", "--update-baseline", "--root", str(tmp_path)],
+        cwd=str(tmp_path), capture_output=True, text=True, env=env,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    counts = load_baseline(str(base))
+    assert counts == {"bare-print": {
+        "pkg/live.py": 1, "pkg/sub/other.py": 1,
+    }}, counts
+
+
+def test_cli_rejects_non_python_file_and_empty_scan(tmp_path):
+    """A non-.py file argument (or a path set yielding zero .py files) is
+    a usage error (exit 2), never a silent green."""
+    (tmp_path / "wrapper.sh").write_text("echo hi\n")
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    env = dict(os.environ, PYTHONPATH=REPO)
+    for arg in ("wrapper.sh", "empty"):
+        proc = subprocess.run(
+            [sys.executable, "-m", "fuzzyheavyhitters_tpu.analysis",
+             arg, "--root", str(tmp_path)],
+            cwd=str(tmp_path), capture_output=True, text=True, env=env,
+            timeout=300,
+        )
+        assert proc.returncode == 2, (arg, proc.stdout, proc.stderr)
+
+
+def test_baseline_rejects_unknown_schema(tmp_path):
+    path = tmp_path / "b.json"
+    path.write_text(json.dumps({"schema": "nope", "counts": {}}))
+    with pytest.raises(ValueError):
+        load_baseline(str(path))
+
+
+# ---------------------------------------------------------------------------
+# config loading
+# ---------------------------------------------------------------------------
+
+
+def test_pyproject_config_loads():
+    cfg = load_config(REPO)
+    assert "run_level" in cfg.hot_roots
+    assert "seed" in cfg.secret_lexicon
+    assert cfg.severity_overrides.get("host-sync-in-hot-loop") == "warning"
+    assert cfg.baseline == "lint_baseline.json"
+
+
+def test_config_defaults_without_pyproject(tmp_path):
+    cfg = load_config(str(tmp_path))
+    assert cfg.hot_roots  # built-in defaults apply
+    assert cfg.baseline == "lint_baseline.json"
+
+
+def test_pyproject_and_dataclass_defaults_do_not_drift():
+    """pyproject.toml [tool.fhh-lint] is the operative tuning and the
+    LintConfig defaults mirror it (fixture tests build bare LintConfig()s).
+    If this fails you edited one copy — update the other to match."""
+    operative = load_config(REPO)
+    defaults = LintConfig()
+    for key in (
+        "hot_modules", "hot_roots", "secret_lexicon", "sink_calls",
+        "print_scope", "print_allowed", "shared_state_modules",
+        "default_paths", "baseline",
+    ):
+        assert getattr(operative, key) == getattr(defaults, key), key
+
+
+# ---------------------------------------------------------------------------
+# self-lint: the repo is clean under the checked-in baseline
+# ---------------------------------------------------------------------------
+
+
+def test_self_lint_repo_clean_under_baseline():
+    """Tier-1 enforcement: zero non-baselined findings at ANY severity over
+    the package + tests, under the checked-in baseline.  A finding here
+    means: fix it, suppress it with a justification, or consciously grow
+    the baseline — never merge it silently."""
+    cfg = load_config(REPO)
+    findings, errors = lint_paths(
+        ["fuzzyheavyhitters_tpu", "tests"], cfg, REPO
+    )
+    assert errors == []
+    counts = load_baseline(os.path.join(REPO, cfg.baseline))
+    res = apply_baseline(findings, counts)
+    assert res.new == [], "new lint findings:\n" + "\n".join(
+        f.render() for f in res.new
+    )
+    # the baseline must not rot silently either: stale entries mean a
+    # finding was fixed — bank it with --update-baseline
+    assert res.stale == [], (
+        "baseline entries no longer needed (run "
+        "`python -m fuzzyheavyhitters_tpu.analysis --update-baseline`): "
+        f"{res.stale}"
+    )
+
+
+def test_every_rule_has_fixture_coverage():
+    """Each shipped rule appears in at least one positive fixture above —
+    guards against a rule being added but never exercised."""
+    covered = {
+        "host-sync-in-hot-loop",
+        "secret-to-sink",
+        "recompile-churn",
+        "unguarded-shared-state",
+        "broad-except",
+        "bare-print",
+    }
+    assert {r.name for r in ALL_RULES} == covered
+
+
+def test_cli_json_strict_on_repo():
+    """The CLI contract the driver and scripts/lint.sh rely on: strict
+    mode exits 0 on the current tree and the JSON document parses."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "fuzzyheavyhitters_tpu.analysis",
+            "fuzzyheavyhitters_tpu",
+            "tests",
+            "--strict",
+            "--format",
+            "json",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["schema"] == "fhh-lint-report/1"
+    assert doc["findings"] == [] and doc["failing"] == 0
+
+
+def test_cli_exit_codes(tmp_path):
+    """Seeded violation -> exit 1 under --no-baseline; clean file -> 0."""
+    bad = tmp_path / "fuzzyheavyhitters_tpu"
+    bad.mkdir()
+    (bad / "mod.py").write_text(
+        "def f(x):\n    print(x)\n"
+    )
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "fuzzyheavyhitters_tpu.analysis",
+            "fuzzyheavyhitters_tpu", "--no-baseline",
+            "--root", str(tmp_path),
+        ],
+        cwd=str(tmp_path), capture_output=True, text=True, env=env,
+        timeout=300,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "bare-print" in proc.stdout
+    (bad / "mod.py").write_text("def f(x):\n    return x\n")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "fuzzyheavyhitters_tpu.analysis",
+            "fuzzyheavyhitters_tpu", "--no-baseline",
+            "--root", str(tmp_path),
+        ],
+        cwd=str(tmp_path), capture_output=True, text=True, env=env,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -17,11 +17,7 @@ import jax
 import jax.numpy as jnp
 
 
-def _has_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
+from conftest import has_tpu as _has_tpu
 
 
 pytestmark = [

@@ -250,3 +250,44 @@ def test_f255_blockpair_roundtrip(rng):
     back = F255.to_numpy_ints(F255.from_blocks(blocks))
     for i, x in enumerate(xs):
         assert int(back[i]) == x
+
+
+# ---------------------------------------------------------------------------
+# host (NumPy) twins: bit-identical with the device versions
+# ---------------------------------------------------------------------------
+
+
+def test_fe62_np_twins_match_device(rng):
+    words = rng.integers(0, 2**32, size=(64, 4), dtype=np.uint32)
+    host = FE62.np_sample(words)
+    dev = np.asarray(FE62.sample(words))
+    np.testing.assert_array_equal(host, dev)
+    a = rng.integers(0, P62, size=64, dtype=np.uint64)
+    b = rng.integers(0, P62, size=64, dtype=np.uint64)
+    np.testing.assert_array_equal(
+        FE62.np_add(a, b), np.asarray(FE62.add(a, b))
+    )
+    # lazily-reduced inputs (the representation FE62 ops produce)
+    lazy = FE62.np_add(a, b)
+    np.testing.assert_array_equal(
+        FE62.np_add(lazy, b), np.asarray(FE62.add(lazy, b))
+    )
+
+
+def test_f255_np_twins_match_device(rng):
+    words = rng.integers(0, 2**32, size=(32, 8), dtype=np.uint32)
+    host = F255.np_sample(words)
+    dev = np.asarray(F255.sample(words))
+    np.testing.assert_array_equal(host, dev)
+    a, b = F255.np_sample(words), F255.np_sample(words[::-1].copy())
+    np.testing.assert_array_equal(
+        F255.np_add(a, b), np.asarray(F255.add(jnp.asarray(a), jnp.asarray(b)))
+    )
+    # edge: operands near p force both the fold and the conditional sub
+    top = np.tile(F255.np_sample(
+        np.full((1, 8), 0xFFFFFFFF, np.uint32)
+    ), (4, 1))
+    np.testing.assert_array_equal(
+        F255.np_add(top, top),
+        np.asarray(F255.add(jnp.asarray(top), jnp.asarray(top))),
+    )

@@ -14,14 +14,7 @@ W=8), and non-block-multiple batch sizes (the pad path).
 import numpy as np
 import pytest
 
-import jax
-
-
-def _has_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
+from conftest import has_tpu as _has_tpu
 
 
 pytestmark = [

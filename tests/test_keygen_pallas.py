@@ -12,11 +12,7 @@ import pytest
 import jax
 
 
-def _has_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
+from conftest import has_tpu as _has_tpu
 
 
 pytestmark = [

@@ -4,7 +4,6 @@ crawl (both socket servers in one process, so the two sides' data-plane
 accounting can be asserted consistent against each other), and the guard
 that no crawl-path module falls back to bare ``print`` telemetry."""
 
-import ast
 import asyncio
 import gc
 import io
@@ -426,36 +425,27 @@ def test_socket_run_report_two_servers_consistent(secure_exchange):
 # guard: no bare print() telemetry in crawl-path modules
 # ---------------------------------------------------------------------------
 
-# matplotlib plot scripts, not crawl-path telemetry
-_PRINT_ALLOWED = {
-    os.path.join("workloads", "ride_austin_visualization.py"),
-    os.path.join("workloads", "covid_data_visualization.py"),
-}
-
 
 def test_no_bare_print_in_package():
     """Crawl-path telemetry goes through obs.emit — a bare print() in the
     package is either a debug leftover or a regression to the stdout
-    scraping this layer replaced."""
-    offenders = []
-    for root, _dirs, files in os.walk(_PKG):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            rel = os.path.relpath(path, _PKG)
-            if rel in _PRINT_ALLOWED:
-                continue
-            with open(path) as f:
-                tree = ast.parse(f.read(), filename=rel)
-            for node in ast.walk(tree):
-                if (
-                    isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Name)
-                    and node.func.id == "print"
-                ):
-                    offenders.append(f"{rel}:{node.lineno}")
-    assert not offenders, (
+    scraping this layer replaced.
+
+    This guard's AST walk was generalized into fhh-lint's ``bare-print``
+    rule; delegating keeps ONE allowlist (pyproject ``[tool.fhh-lint]``
+    ``print_allowed``) instead of a drifting copy here.  The self-lint
+    test in test_analysis.py enforces the full rule set; this asserts the
+    specific print contract survives any baseline/severity tuning."""
+    from fuzzyheavyhitters_tpu.analysis import lint_paths, load_config
+    from fuzzyheavyhitters_tpu.analysis.rules import RULES_BY_NAME
+
+    repo = os.path.dirname(_PKG)
+    findings, errors = lint_paths(
+        ["fuzzyheavyhitters_tpu"], load_config(repo), repo,
+        rules=[RULES_BY_NAME["bare-print"]],
+    )
+    assert errors == []
+    assert not findings, (
         "bare print() telemetry found (use fuzzyheavyhitters_tpu.obs.emit): "
-        + ", ".join(offenders)
+        + ", ".join(f"{f.path}:{f.line}" for f in findings)
     )
