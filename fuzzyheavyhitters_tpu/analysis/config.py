@@ -78,6 +78,12 @@ class LintConfig:
         "fuzzyheavyhitters_tpu/native",
         "fuzzyheavyhitters_tpu/protocol/rpc.py",
     )
+    # unbounded-await rule: transport modules where every await on a
+    # network read / event wait / dial must carry a timeout or deadline
+    await_modules: tuple = (
+        "fuzzyheavyhitters_tpu/protocol",
+        "fuzzyheavyhitters_tpu/resilience",
+    )
     severity_overrides: dict = field(default_factory=dict)
     baseline: str = "lint_baseline.json"
     default_paths: tuple = ("fuzzyheavyhitters_tpu", "tests")
@@ -196,6 +202,7 @@ def load_config(root: str | None = None, pyproject: str | None = None) -> LintCo
         "print_scope",
         "print_allowed",
         "shared_state_modules",
+        "await_modules",
         "default_paths",
     ):
         val = section.get(key)

@@ -1,6 +1,6 @@
 """The fhh-lint rule set, tuned to this codebase's invariants.
 
-Six rules over five concerns (the broad-except/bare-print concern ships
+Seven rules over six concerns (the broad-except/bare-print concern ships
 as two rules so suppressions and severities stay per-rule):
 
 - ``host-sync-in-hot-loop`` — device->host synchronization primitives
@@ -33,6 +33,15 @@ as two rules so suppressions and severities stay per-rule):
 - ``bare-print`` — ``print()`` in crawl-path package modules (the
   ``test_obs`` stdout-hygiene guard, generalized): telemetry goes
   through ``obs.emit``; stdout stays a clean program-output channel.
+- ``unbounded-await`` — ``await`` on network reads (``readexactly``,
+  ``read``, ...), ``asyncio.wait``, event waits, or dials carrying no
+  timeout/deadline, in the configured transport modules
+  (``await_modules``: protocol + resilience).  A black-holed peer (no
+  FIN, no RST, frames silently dropped) hangs such an await forever;
+  the resilience layer's whole premise is that every wait is bounded —
+  by a kwarg timeout, ``asyncio.wait_for``, or a ``Deadline`` — and the
+  deliberately-unbounded sites (serve loops waiting for the next
+  command) carry inline suppressions with justifications.
 """
 
 from __future__ import annotations
@@ -608,6 +617,81 @@ class BarePrint(Rule):
                 )
 
 
+# ---------------------------------------------------------------------------
+# 7. unbounded-await
+# ---------------------------------------------------------------------------
+
+# attribute calls whose await can hang forever on a wedged/black-holed
+# peer: stream reads, event/condition waits (incl. asyncio.wait itself)
+_AWAIT_NET_METHODS = {"readexactly", "readuntil", "readline", "read", "wait"}
+
+
+class UnboundedAwait(Rule):
+    name = "unbounded-await"
+    default_severity = "warning"
+
+    def check(self, mod: SourceModule, cfg):
+        if not _under_prefix(mod.relpath, cfg.await_modules):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Await) or not isinstance(
+                node.value, ast.Call
+            ):
+                continue
+            call = node.value
+            dn = dotted_name(call.func)
+            seg = last_segment(dn)
+            if seg == "wait_for":
+                # the bounded wrapper — unless its timeout is literally
+                # None, which is an unbounded await in disguise
+                if self._timeout_is_none(call):
+                    yield (
+                        *_span(node),
+                        "wait_for with timeout=None is an unbounded "
+                        "await in disguise — pass a finite deadline",
+                    )
+                continue
+            if seg == "open_connection":
+                yield (
+                    *_span(node),
+                    "await on a bare dial: the OS SYN timeout is minutes "
+                    "— wrap in asyncio.wait_for with a dial timeout "
+                    "(resilience.policy.DIAL_TIMEOUT_S)",
+                )
+                continue
+            if (
+                seg in _AWAIT_NET_METHODS
+                and isinstance(call.func, ast.Attribute)
+                and not self._has_finite_timeout(call)
+            ):
+                yield (
+                    *_span(node),
+                    f"await on '{dn or seg}(...)' carries no timeout or "
+                    "deadline — a black-holed peer hangs this task "
+                    "forever (pass timeout=, bound with asyncio.wait_for/"
+                    "Deadline, or suppress with a justification)",
+                )
+
+    @staticmethod
+    def _timeout_kwarg(call: ast.Call):
+        for kw in call.keywords:
+            if kw.arg == "timeout":
+                return kw.value
+        return None
+
+    @classmethod
+    def _timeout_is_none(cls, call: ast.Call) -> bool:
+        t = call.args[1] if len(call.args) >= 2 else cls._timeout_kwarg(call)
+        return isinstance(t, ast.Constant) and t.value is None
+
+    @classmethod
+    def _has_finite_timeout(cls, call: ast.Call) -> bool:
+        t = cls._timeout_kwarg(call)
+        if t is None:
+            return False
+        return not (isinstance(t, ast.Constant) and t.value is None)
+
+
 ALL_RULES: tuple[Rule, ...] = (
     HostSyncInHotLoop(),
     SecretToSink(),
@@ -615,6 +699,7 @@ ALL_RULES: tuple[Rule, ...] = (
     UnguardedSharedState(),
     BroadExcept(),
     BarePrint(),
+    UnboundedAwait(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -118,15 +118,26 @@ async def _run(cfg, nreqs: int, rng) -> None:
     h1, p1 = _split(cfg.server1)
     c0 = await CollectorClient.connect(h0, p0)
     c1 = await CollectorClient.connect(h1, p1)
-    await asyncio.gather(c0.call("reset"), c1.call("reset"))
 
     lead = RpcLeader(cfg, c0, c1)
+    # supervised crawl (FHH_SUPERVISE=0 opts out; malicious mode cannot
+    # roll back — see RpcLeader.run_supervised — so it keeps the plain
+    # path): the leader checkpoints every FHH_CKPT_EVERY levels and, on
+    # any transport loss or server restart, restores both servers and
+    # re-runs only the lost levels
+    supervise = os.environ.get("FHH_SUPERVISE", "1") != "0" and not cfg.malicious
     t0 = time.perf_counter()
-    await lead.upload_keys(k0, k1, sk0, sk1)
-    obs.emit("addkeys.done", seconds=round(time.perf_counter() - t0, 2))
-
-    t0 = time.perf_counter()
-    res = await lead.run(nreqs)
+    if supervise:
+        res = await lead.run_supervised(
+            nreqs, k0, k1,
+            checkpoint_every=int(os.environ.get("FHH_CKPT_EVERY", "16")),
+        )
+    else:
+        await asyncio.gather(c0.call("reset"), c1.call("reset"))
+        await lead.upload_keys(k0, k1, sk0, sk1)
+        obs.emit("addkeys.done", seconds=round(time.perf_counter() - t0, 2))
+        t0 = time.perf_counter()
+        res = await lead.run(nreqs)
     obs.emit("crawl.done", seconds=round(time.perf_counter() - t0, 2))
 
     for row, c in zip(res.decode_ints(), res.counts):

@@ -8,11 +8,19 @@ Run one per party::
 Startup order mirrors the reference (server.rs:344-354): the data-plane
 socket between the two servers is established BEFORE the leader-facing RPC
 listener binds, server1 listening / server0 dialing with retries.
+
+Fault tolerance: set ``FHH_CKPT_DIR`` to a writable directory to enable
+the ``tree_checkpoint``/``tree_restore`` verbs — a supervised leader
+(``FHH_SUPERVISE``, bin/leader.py) then rolls a faulted crawl back to the
+last checkpoint instead of restarting it; without the dir the server
+still reconnect-dedups replayed verbs, and recovery degrades to
+restart-from-scratch.
 """
 
 from __future__ import annotations
 
 import asyncio
+import os
 
 from .. import obs
 from ..protocol.rpc import CollectorServer
@@ -52,7 +60,10 @@ async def amain(cfg, server_id: int) -> None:
         else contextlib.nullcontext()
     )
     with ctx:
-        server = CollectorServer(server_id, cfg)
+        ckpt_dir = os.environ.get("FHH_CKPT_DIR") or None
+        if ckpt_dir is not None:
+            os.makedirs(ckpt_dir, exist_ok=True)
+        server = CollectorServer(server_id, cfg, ckpt_dir=ckpt_dir)
         srv = await server.start(my_host, my_port, peer_host, peer_port)
         obs.emit("server.serving", server=server_id, host=my_host, port=my_port)
         async with srv:

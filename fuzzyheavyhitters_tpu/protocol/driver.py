@@ -348,23 +348,28 @@ class Leader:
         if fp is None:
             import jax.numpy as jnp
 
-            h = hashlib.sha256()
+            # Phase 1 — compute every piece WITHOUT fetching: per server,
+            # the identity arrays (key_idx, root_seed) plus one reduced
+            # plane per cw tensor.  All-level cw planes: seeds
+            # [N, d, 2, L, 4] plus the t/y bit planes [N, d, 2, L, 2] (a
+            # divergence at any level lands in at least one); reduce with
+            # the array's own backend — streaming mode holds host keys,
+            # uploading them just to reduce would defeat the point — and
+            # in client CHUNKS: at the flagship 196k x L=512 shape a
+            # full-batch weighted product would transiently double the
+            # ~3 GB plane in host RAM (or HBM, which the crawl already
+            # runs near the limit of) at checkpoint time.
+            fetch: list = []  # device/host arrays, ONE stacked device_get
+            layout: list = []  # hash order: ("arr", fetch_i) | ("red", red_i)
+            device_reds: list = []  # raveled on-device reductions
             for s in (self.server0, self.server1):
-                key_idx = np.asarray(s.keys.key_idx)
-                h.update(np.ascontiguousarray(key_idx))
-                h.update(np.ascontiguousarray(np.asarray(s.keys.root_seed)))
-                n = key_idx.shape[0]
-                # all-level cw planes: seeds [N, d, 2, L, 4] plus the t/y
-                # bit planes [N, d, 2, L, 2] (a divergence at any level
-                # lands in at least one); reduce with the array's own
-                # backend — streaming mode holds host keys, uploading
-                # them just to reduce would defeat the point — and in
-                # client CHUNKS: at the flagship 196k x L=512 shape a
-                # full-batch weighted product would transiently double
-                # the ~3 GB plane in host RAM (or HBM, which the crawl
-                # already runs near the limit of) at checkpoint time
+                for ident in (s.keys.key_idx, s.keys.root_seed):
+                    layout.append(("arr", len(fetch)))
+                    fetch.append(ident)
+                n = s.keys.key_idx.shape[0]
                 for plane in (s.keys.cw_seed, s.keys.cw_bits, s.keys.cw_y_bits):
-                    xp = jnp if isinstance(plane, jax.Array) else np
+                    on_device = isinstance(plane, jax.Array)
+                    xp = jnp if on_device else np
                     red = None
                     for i in range(0, n, 4096):
                         p = xp.asarray(plane[i : i + 4096], dtype=xp.uint32)
@@ -374,7 +379,31 @@ class Leader:
                         ).reshape((p.shape[0],) + (1,) * (p.ndim - 1))
                         part = (p * w).sum(axis=0, dtype=xp.uint32)
                         red = part if red is None else red + part
-                    h.update(np.ascontiguousarray(np.asarray(red)))
+                    if on_device:
+                        layout.append(("red", len(device_reds)))
+                        device_reds.append(red.ravel())
+                    else:
+                        layout.append(("arr", len(fetch)))
+                        fetch.append(red)
+            # Phase 2 — ONE stacked transfer (was: one np.asarray per
+            # piece, up to 8 device round trips per checkpoint): the six
+            # plane reductions concatenate into a single device array and
+            # ride one device_get together with the identity arrays
+            # (host-resident ones pass through untouched)
+            sizes = [r.size for r in device_reds]
+            if device_reds:
+                fetch.append(jnp.concatenate(device_reds))
+            host = jax.device_get(fetch)
+            offsets = np.cumsum([0] + sizes)
+            red_cat = host[-1] if device_reds else None
+            h = hashlib.sha256()
+            for kind, idx in layout:
+                arr = (
+                    host[idx]
+                    if kind == "arr"
+                    else red_cat[offsets[idx] : offsets[idx + 1]]
+                )
+                h.update(np.ascontiguousarray(arr))
             fp = self._key_fp = np.frombuffer(h.digest(), np.uint8)
         return fp
 
@@ -408,11 +437,15 @@ class Leader:
             blob["params"] = np.array([float(nreqs), float(threshold)])
         for i, s in enumerate((self.server0, self.server1)):
             st = s.frontier.states
-            blob[f"s{i}_seed"] = np.asarray(st.seed)
-            blob[f"s{i}_bit"] = np.asarray(st.bit)
-            blob[f"s{i}_y_bit"] = np.asarray(st.y_bit)
-            blob[f"s{i}_alive"] = np.asarray(s.frontier.alive)
-            blob[f"s{i}_alive_keys"] = np.asarray(s.alive_keys)
+            blob[f"s{i}_seed"] = st.seed
+            blob[f"s{i}_bit"] = st.bit
+            blob[f"s{i}_y_bit"] = st.y_bit
+            blob[f"s{i}_alive"] = s.frontier.alive
+            blob[f"s{i}_alive_keys"] = s.alive_keys
+        # ONE stacked fetch for both servers' state planes (was: one
+        # np.asarray per plane, 10 device round trips per checkpoint);
+        # host-resident entries pass through device_get untouched
+        blob = jax.device_get(blob)
         tmp = f"{path}.tmp"
         with open(tmp, "wb") as f:
             np.savez(f, **blob)

@@ -21,7 +21,7 @@ from ..ops.ibdcf import IbDcfKeyBatch
 from ..utils.config import Config
 from . import collect
 from .driver import CrawlResult
-from .rpc import CollectorClient
+from .rpc import CollectorClient, ServerRestartedError
 
 
 def _key_chunk(keys: IbDcfKeyBatch, sl: slice):
@@ -50,8 +50,37 @@ class RpcLeader:
         # the leader's registry, not the process default
         client0.obs = client1.obs = self.obs
 
+    @staticmethod
+    async def _all(*coros):
+        """Gather with cancel-on-first-failure.  Plain ``asyncio.gather``
+        leaves the other awaitables RUNNING when one raises — for this
+        client that means an orphaned call still replaying its verb while
+        the supervisor rolls both servers back, and a replayed
+        never-executed ``tree_prune``/``add_keys`` landing AFTER a
+        ``tree_restore``/``reset`` would corrupt the restored state.
+        Cancelling the siblings kills their replay loops; anything that
+        already executed server-side is answered from the dedup cache."""
+        tasks = [asyncio.ensure_future(c) for c in coros]
+        # fhh-lint: disable=unbounded-await (every child is a client call
+        # bounded by its own per-verb wall-clock budget; a second timeout
+        # here would race the real one)
+        done, pending = await asyncio.wait(
+            tasks, return_when=asyncio.FIRST_EXCEPTION
+        )
+        failed = next((t for t in done if t.exception() is not None), None)
+        if failed is not None:
+            for t in pending:
+                t.cancel()
+            for t in pending:
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):  # fhh-lint: disable=broad-except (cancellation sweep: sibling errors are subsumed by the first failure, re-raised below)
+                    pass
+            raise failed.exception()
+        return [t.result() for t in tasks]
+
     async def _both(self, verb: str, req=None):
-        return await asyncio.gather(self.c0.call(verb, req), self.c1.call(verb, req))
+        return await self._all(self.c0.call(verb, req), self.c1.call(verb, req))
 
     async def upload_keys(
         self,
@@ -59,16 +88,19 @@ class RpcLeader:
         keys1: IbDcfKeyBatch,
         sketch0=None,
         sketch1=None,
+        which: int | None = None,
     ):
         """Batched async key upload with a ROLLING in-flight window (ref:
         leader.rs:340-364: 1000 addkey batches in flight, refilled as each
         completes — not drained in bursts: a stop-and-wait gather leaves
         the pipe empty while the slowest request of each burst finishes).
         Optional sketch key batches ride in the same requests
-        (malicious-secure mode)."""
+        (malicious-secure mode).  ``which`` (0 or 1) uploads to ONE
+        server only — the recovery path re-seeding a restarted server."""
         n = np.asarray(keys0.cw_seed).shape[0]
         bs = max(1, self.cfg.addkey_batch_size)
-        self.has_sketch = sketch0 is not None
+        if which is None:
+            self.has_sketch = sketch0 is not None
 
         def sk_chunk(sk, sl):
             if sk is None:
@@ -91,9 +123,13 @@ class RpcLeader:
             tasks = []
             for lo in range(0, n, bs):
                 sl = slice(lo, min(lo + bs, n))
-                tasks.append(send_one(self.c0, keys0, sketch0, sl))
-                tasks.append(send_one(self.c1, keys1, sketch1, sl))
-            await asyncio.gather(*tasks)
+                if which in (None, 0):
+                    tasks.append(send_one(self.c0, keys0, sketch0, sl))
+                if which in (None, 1):
+                    tasks.append(send_one(self.c1, keys1, sketch1, sl))
+            # cancel-on-first-failure: an orphaned add_keys replay landing
+            # after a recovery reset would append a duplicate key chunk
+            await self._all(*tasks)
         self.obs.count("keys_uploaded", n)
 
     async def _run_one_level(self, level: int, nreqs: int, thresh: int):
@@ -215,6 +251,191 @@ class RpcLeader:
         # final reconstruction from re-served leaf shares: v0 - v1 per
         # surviving leaf (ref: collect.rs:993-1029 final_shares/final_values;
         # the crawl-time counts are only the pruning signal)
+        f0, f1 = await self._both("final_shares")
+        v = np.asarray(F255.sub(f0["shares"], f1["shares"]))
+        final_counts = v[..., 0].astype(np.uint32)
+        if np.any(v[..., 1:]) or not np.array_equal(final_counts, counts_kept):
+            raise RuntimeError("final share reconstruction mismatch")
+        return CrawlResult(paths=self.paths, counts=final_counts)
+
+    # -- fault-tolerant crawl (resilience layer) -------------------------
+
+    @staticmethod
+    async def _probe(client) -> dict:
+        """``status`` with restart absorption: the reconnect handshake may
+        discover a new boot id and poison the FIRST call with
+        ServerRestartedError; the second call runs against the fresh boot
+        and is replay-free by construction."""
+        try:
+            return await client.call("status")
+        except ServerRestartedError:
+            return await client.call("status")
+
+    async def _recover(self, keys0, keys1, stash) -> int:
+        """Bring both servers back to one consistent state after any
+        control-plane, data-plane, or server loss; returns the next level
+        to run.  With a checkpoint stash: redial, re-establish the data
+        plane, re-seed restarted servers' keys, ``tree_restore`` both to
+        the stash level.  Without one: full restart from level 0."""
+        # probe s0 first: the supervisor's client redials under policy
+        st0 = await self._probe(self.c0)
+        # re-establish the data plane via the DIALER side, always: a
+        # surviving s0's plane may be half-dead, and a restarted s1 can't
+        # even serve its control plane until s0 redials (its start()
+        # blocks on the plane accept before binding the RPC listener)
+        await self.c0.call("plane_reset")
+        st1 = await self._probe(self.c1)
+        restarted = []
+        for i, st in enumerate((st0, st1)):
+            if st["boot_id"] != self._boot_ids.get(i):
+                restarted.append(i)
+            self._boot_ids[i] = st["boot_id"]
+        if stash is None:
+            # no checkpoint to stand on: restart the crawl from scratch
+            await self._both("reset")
+            await self.upload_keys(keys0, keys1)
+            await self._both("tree_init", {"root_bucket": self.min_bucket})
+            self.paths = np.zeros((1, self.cfg.n_dims, 0), bool)
+            self.n_nodes = 1
+            obsmod.emit(
+                "resilience.restarted_from_scratch",
+                severity="warn",
+                restarted_servers=restarted,
+            )
+            return 0
+        level, paths, n_nodes = stash[0], stash[1], stash[2]
+        for i in restarted:
+            # a restarted server lost its key batch; re-seed it before
+            # tree_restore re-concatenates (NO reset here: reset would
+            # delete the very checkpoint files we are about to restore)
+            await self.upload_keys(keys0, keys1, which=i)
+        r0, r1 = await self._both("tree_restore", {"level": level})
+        if int(r0["level"]) != level or int(r1["level"]) != level:
+            raise RuntimeError(
+                f"restored levels diverge: s0={r0['level']} s1={r1['level']} "
+                f"leader stash={level}"
+            )
+        self.paths = paths.copy()
+        self.n_nodes = n_nodes
+        obsmod.emit(
+            "resilience.restored",
+            level=level,
+            restarted_servers=restarted,
+        )
+        return level + 1
+
+    async def run_supervised(
+        self,
+        nreqs: int,
+        keys0: IbDcfKeyBatch,
+        keys1: IbDcfKeyBatch,
+        *,
+        checkpoint_every: int = 8,
+        max_recoveries: int = 4,
+    ) -> CrawlResult:
+        """The fault-tolerant twin of :meth:`run`, owning the WHOLE crawl
+        (reset + upload + levels + final reconstruction) because recovery
+        needs the key batches to re-seed a restarted server.
+
+        Per completed ``checkpoint_every`` levels it instructs both
+        servers to ``tree_checkpoint`` and stashes the leader-side path
+        bookkeeping; on any transport loss, server restart, or verb
+        failure it rolls BOTH servers back to the last stash (fresh
+        data-plane handshake included) and re-runs only the lost levels.
+        Counts are exact re-runs: a recovered crawl's results are
+        bit-identical to a fault-free one.
+
+        Malicious (sketch) mode is refused: the sketch challenge seed is
+        per-data-plane-session and stored pair shares open exactly once,
+        so a mid-crawl rollback would either replay a challenge or leak
+        (see ``rpc.sketch_verify``).  Checkpointing degrades gracefully:
+        servers without a checkpoint dir disable it (recovery then means
+        restart-from-scratch), keeping supervision usable everywhere."""
+        cfg = self.cfg
+        d, L = cfg.n_dims, cfg.data_len
+        if cfg.malicious or self.has_sketch:
+            # refuse BEFORE touching the servers: proceeding would upload
+            # keys without their sketch material and silently run a
+            # malicious-mode collection semi-honest
+            raise ValueError(
+                "run_supervised does not support malicious (sketch) mode"
+            )
+        thresh = max(1, int(cfg.threshold * nreqs))
+        await self._both("reset")
+        await self.upload_keys(keys0, keys1)
+        await self._both("tree_init", {"root_bucket": self.min_bucket})
+        self.paths = np.zeros((1, d, 0), bool)
+        self.n_nodes = 1
+        self._boot_ids = {
+            0: self.c0.boot_id,
+            1: self.c1.boot_id,
+        }
+        stash = None  # (level, paths, n_nodes, counts_kept) at last ckpt
+        counts_kept = np.zeros(0, np.uint32)
+        ckpt_enabled = True
+        recoveries = 0
+        level = 0
+        while level < L:
+            try:
+                with self.obs.span("level", level=level):
+                    counts_kept, _ = await self._run_one_level(
+                        level, nreqs, thresh
+                    )
+                if counts_kept is None:
+                    return CrawlResult(
+                        paths=np.zeros((0, d, level + 1), bool),
+                        counts=np.zeros(0, np.uint32),
+                    )
+                if (
+                    ckpt_enabled
+                    and level < L - 1
+                    and (level + 1) % checkpoint_every == 0
+                ):
+                    try:
+                        await self._both("tree_checkpoint", {"level": level})
+                        stash = (
+                            level,
+                            self.paths.copy(),
+                            self.n_nodes,
+                            counts_kept.copy(),
+                        )
+                        self.obs.count("crawl_checkpoints", level=level)
+                    except RuntimeError as e:
+                        # servers can't checkpoint (no FHH_CKPT_DIR):
+                        # supervise without — recovery restarts from 0
+                        ckpt_enabled = False
+                        obsmod.emit(
+                            "resilience.checkpoint_disabled",
+                            severity="warn",
+                            error=str(e),
+                        )
+                level += 1
+            except (ConnectionError, TimeoutError, RuntimeError) as err:
+                while True:
+                    recoveries += 1
+                    self.obs.count("recoveries")
+                    obsmod.emit(
+                        "resilience.recover",
+                        severity="warn",
+                        level=level,
+                        attempt=recoveries,
+                        error=f"{type(err).__name__}: {err}",
+                    )
+                    if recoveries > max_recoveries:
+                        raise err
+                    try:
+                        level = await self._recover(keys0, keys1, stash)
+                        break
+                    except (ConnectionError, TimeoutError, RuntimeError) as e2:
+                        err = e2  # recovery itself failed: another round
+                counts_kept = (
+                    stash[3].copy()
+                    if stash is not None
+                    else np.zeros(0, np.uint32)
+                )
+                self.obs.count("levels_rerun")
+        # final reconstruction, as in run() (final_shares is read-only:
+        # the client's transparent replay covers transient losses here)
         f0, f1 = await self._both("final_shares")
         v = np.asarray(F255.sub(f0["shares"], f1["shares"]))
         final_counts = v[..., 0].astype(np.uint32)

@@ -29,11 +29,31 @@ and servers rebuild child nodes eagerly; here prune and child
 materialization are fused — the leader sends (parent_idx, pattern, n_alive)
 and the server advances only the survivors (see protocol/collect.py's
 memory plan).
+
+Fault tolerance (the resilience layer, this repo's addition — the
+reference restarts the whole run on any socket error):
+
+- the leader-side :class:`CollectorClient` RECONNECTS: on transport loss
+  it redials under the shared backoff policy
+  (``resilience.policy.DIAL_POLICY``), bumps its session *epoch*, and
+  replays the calls whose responses never arrived;
+- the server answers replays IDEMPOTENTLY: each leader session keeps a
+  bounded ``(req_id) → response`` dedup cache plus an in-flight table, so
+  a stateful verb (``tree_prune``, ``add_keys``) that already ran is
+  answered from cache instead of double-applied;
+- ``tree_checkpoint``/``tree_restore`` verbs persist/reload the server's
+  crawl state at leader-chosen level boundaries, and ``plane_reset``
+  re-establishes the server↔server data plane (redial + fresh
+  ``_plane_handshake``) after a peer loss — together they let
+  ``RpcLeader.run_supervised`` re-run only the lost levels.
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections as _collections
+import hashlib
+import os
 import pickle
 import secrets as _secrets
 import struct
@@ -48,7 +68,8 @@ from .. import obs
 from ..obs import metrics as obsmetrics
 from ..ops import baseot, dpf, gc, ibdcf, otext, prg
 from ..ops.fields import F255, FE62
-from ..ops.ibdcf import IbDcfKeyBatch
+from ..ops.ibdcf import EvalState, IbDcfKeyBatch
+from ..resilience import policy as respolicy
 from ..utils.config import Config
 from . import collect, mpc, secure, sketch as sketchmod
 
@@ -79,10 +100,16 @@ async def _send(writer: asyncio.StreamWriter, obj, count=None) -> None:
 
 
 async def _recv(reader: asyncio.StreamReader, count=None):
+    """Frame reads are DELIBERATELY unbounded: serve/reader loops wait
+    indefinitely for the next frame by design — response waits are
+    bounded at the caller (per-verb ``Deadline`` on the pending future)
+    and the data plane by TCP keepalive, not by a read timeout here."""
+    # fhh-lint: disable=unbounded-await (see docstring)
     hdr = await reader.readexactly(_HDR.size)
     (n,) = _HDR.unpack(hdr)
     if count is not None:
         count(n + _HDR.size)
+    # fhh-lint: disable=unbounded-await (see docstring)
     return pickle.loads(await reader.readexactly(n))
 
 
@@ -132,6 +159,65 @@ def mask_f255(level: int, n: int) -> np.ndarray:
 # Server
 # ---------------------------------------------------------------------------
 
+# dedup bounds: the cache must cover every req_id a client could still
+# replay — at most its in-flight window (the key-upload window of 256 in
+# leader_rpc.upload_keys is the largest) plus slack — and is bounded by
+# BYTES as well as count: verb responses carry share arrays (tree_crawl,
+# final_shares) that can each be MBs at production scale, and a
+# count-only bound would pin ~1024 of them.  Sessions are bounded too, so
+# a leader that reconnects under fresh session ids can't grow server
+# memory without bound.
+_SESSION_CACHE_CAP = 1024
+_SESSION_CACHE_BYTES = 128 << 20
+_SESSION_CAP = 8
+
+
+def _resp_nbytes(resp) -> int:
+    """Approximate retained size of a cached response (array payloads
+    dominate; containers/scalars get a flat floor)."""
+    if isinstance(resp, np.ndarray):
+        return resp.nbytes + 64
+    if isinstance(resp, dict):
+        return 64 + sum(_resp_nbytes(v) for v in resp.values())
+    if isinstance(resp, (list, tuple)):
+        return 64 + sum(_resp_nbytes(v) for v in resp)
+    return 64
+
+
+class _Session:
+    """One leader session's idempotent-replay state: responses already
+    sent (``cache``) and verbs still executing (``inflight``).  A replay
+    of a cached req_id is answered from the cache; a replay of an
+    in-flight req_id awaits the SAME execution — the verb never runs
+    twice either way."""
+
+    __slots__ = (
+        "epoch", "cache", "sizes", "bytes_total", "inflight", "last_seen"
+    )
+
+    def __init__(self):
+        self.epoch = 0
+        self.cache: _collections.OrderedDict = _collections.OrderedDict()
+        self.sizes: dict[int, int] = {}
+        self.bytes_total = 0
+        self.inflight: dict[int, asyncio.Future] = {}
+        self.last_seen = time.monotonic()
+
+    def put(self, req_id, resp) -> None:
+        """Cache a response under the count AND byte bounds.  The newest
+        entry always survives even when it alone exceeds the byte cap —
+        replay correctness of the in-flight call beats the bound."""
+        nb = _resp_nbytes(resp)
+        self.cache[req_id] = resp
+        self.sizes[req_id] = nb
+        self.bytes_total += nb
+        while len(self.cache) > 1 and (
+            len(self.cache) > _SESSION_CACHE_CAP
+            or self.bytes_total > _SESSION_CACHE_BYTES
+        ):
+            old, _ = self.cache.popitem(last=False)
+            self.bytes_total -= self.sizes.pop(old, 0)
+
 
 @dataclass
 class CollectorServer:
@@ -171,6 +257,16 @@ class CollectorServer:
     # their accounting consistent against each other.
     obs: obsmetrics.Registry | None = None
     _verb_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+    # resilience state: where tree_checkpoint persists crawl state (None
+    # disables the verb), the boot id that lets a reconnecting leader
+    # distinguish "same process, blipped network" from "restarted, state
+    # gone", per-leader-session replay dedup, and the peer address kept
+    # for plane_reset redials
+    ckpt_dir: str | None = None
+    _boot_id: str = field(default_factory=lambda: _secrets.token_hex(8))
+    _sessions: dict = field(default_factory=dict)
+    _peer_addr: tuple | None = None
+    _ctl_writers: set = field(default_factory=set)
 
     def __post_init__(self):
         if self.obs is None:
@@ -192,6 +288,7 @@ class CollectorServer:
         self._sketch_depth = 0
         self._sketch_pairs = None
         self._sketch_pairs_field = None
+        self._ckpt_clear()  # a new collection must not resume an old one's
         self.obs.reset()  # fresh per-collection phase/byte/fetch accounting
         if self._ot is not None:  # fresh GC/b2a randomness per collection
             self._sec_seed = np.frombuffer(
@@ -213,16 +310,22 @@ class CollectorServer:
             )
         return True
 
-    async def tree_init(self, req) -> bool:
-        if not self.keys_parts:
-            raise RuntimeError("tree_init before add_keys")
-        root_bucket = int((req or {}).get("root_bucket", 1))
+    def _concat_keys(self) -> None:
+        """Materialize ``self.keys`` from the uploaded chunks (shared by
+        ``tree_init`` and ``tree_restore`` — a restored server re-receives
+        its key chunks but must NOT re-root its frontier)."""
         self.keys = IbDcfKeyBatch(
             *[
                 np.concatenate([np.asarray(p[i]) for p in self.keys_parts])
                 for i in range(len(self.keys_parts[0]))
             ]
         )
+
+    async def tree_init(self, req) -> bool:
+        if not self.keys_parts:
+            raise RuntimeError("tree_init before add_keys")
+        root_bucket = int((req or {}).get("root_bucket", 1))
+        self._concat_keys()
         n = self.keys.cw_seed.shape[0]
         self.alive_keys = np.ones(n, bool)
         self.frontier = collect.tree_init(self.keys, root_bucket)
@@ -661,6 +764,193 @@ class CollectorServer:
         live with the leader in this design, see protocol/collect.py)."""
         return {"server_id": self.server_id, "shares": self._last_shares}
 
+    # -- resilience verbs (no reference analogue: the reference's only
+    # recovery verb is reset, server.rs:64-69) ---------------------------
+
+    async def status(self, _req) -> dict:
+        """Cheap probe for the supervising leader: the boot id tells a
+        reconnecting leader whether this is the same process (replay is
+        safe) or a restart (state is gone — restore path), and the dedup
+        counter lets recovery tests assert no verb double-applied."""
+        return {
+            "boot_id": self._boot_id,
+            "has_keys": self.keys is not None or bool(self.keys_parts),
+            "has_frontier": self.frontier is not None,
+            "dedup_hits": int(self.obs.counter_value("dedup_hits")),
+            "plane_resets": int(self.obs.counter_value("plane_resets")),
+        }
+
+    def _ckpt_path(self, level: int) -> str:
+        # level-stamped: a torn checkpoint round (one server wrote level k,
+        # the other died first) must leave BOTH servers able to restore the
+        # same earlier level — the leader names the level, the file for it
+        # either exists on both or the stash was never advanced
+        return os.path.join(
+            self.ckpt_dir, f"fhh_server{self.server_id}_l{level}.npz"
+        )
+
+    def _ckpt_prune(self, keep: int = 2) -> None:
+        """Drop all but the newest ``keep`` checkpoint levels (the leader
+        only ever restores its last acknowledged stash, which is at most
+        one boundary behind the newest file)."""
+        prefix = f"fhh_server{self.server_id}_l"
+        found = []
+        for name in os.listdir(self.ckpt_dir):
+            if name.startswith(prefix) and name.endswith(".npz"):
+                try:
+                    found.append((int(name[len(prefix):-4]), name))
+                except ValueError:
+                    continue
+        found.sort()
+        # NB: found[:-keep] would be the EMPTY slice at keep=0 ([-0] == [0])
+        doomed = found[: len(found) - keep] if keep else found
+        for _, name in doomed:
+            os.remove(os.path.join(self.ckpt_dir, name))
+
+    def _ckpt_clear(self) -> None:
+        if self.ckpt_dir is not None and os.path.isdir(self.ckpt_dir):
+            self._ckpt_prune(keep=0)
+
+    def _keys_fp(self) -> np.ndarray:
+        """Cheap key identity for checkpoint/restore pairing: key_idx +
+        root seeds.  Unlike the driver's every-plane fingerprint this is
+        an OPERATIONAL check (did the leader re-upload the same batch it
+        crawled with), not a cryptographic one — the leader is trusted
+        with key halves by definition."""
+        h = hashlib.sha256()
+        h.update(np.ascontiguousarray(np.asarray(self.keys.key_idx)))
+        h.update(np.ascontiguousarray(np.asarray(self.keys.root_seed)))
+        return np.frombuffer(h.digest(), np.uint8)
+
+    async def tree_checkpoint(self, req) -> dict:
+        """Persist the crawl state AFTER the given level completed:
+        frontier eval states + node liveness + client liveness + the
+        state layout flag (planar Pallas vs interleaved XLA — a restore
+        under the other engine converts).  Keys are NOT in the blob (the
+        leader re-uploads them on a restart — they are the bulk of the
+        bytes and the leader already holds them).  Atomic tmp+rename so
+        a crash mid-write never corrupts the previous checkpoint."""
+        if self.ckpt_dir is None:
+            raise RuntimeError(
+                "tree_checkpoint: no checkpoint dir configured "
+                "(start the server with FHH_CKPT_DIR set)"
+            )
+        if self._sketch is not None:
+            raise RuntimeError(
+                "malicious-secure crawls are not checkpointable: the "
+                "sketch challenge seed is per-data-plane-session and the "
+                "stored pair shares must open exactly once"
+            )
+        if self.frontier is None:
+            raise RuntimeError("tree_checkpoint before tree_init")
+        level = int(req["level"])
+        st = self.frontier.states
+        # ONE stacked fetch for the whole blob (device_get of the pytree),
+        # not one sync per plane — through a remote-chip tunnel each fetch
+        # is a full round trip
+        blob = jax.device_get(
+            {
+                "seed": st.seed,
+                "bit": st.bit,
+                "y_bit": st.y_bit,
+                "alive": self.frontier.alive,
+            }
+        )
+        blob["alive_keys"] = np.asarray(self.alive_keys)
+        blob["level"] = np.int64(level)
+        blob["planar"] = np.bool_(collect._expand_engine())
+        blob["keys_fp"] = self._keys_fp()
+        path = self._ckpt_path(level)
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(f, **blob)
+        os.replace(tmp, path)
+        self._ckpt_prune()
+        self.obs.count("checkpoint_writes", level=level)
+        obs.emit(
+            "resilience.server_checkpoint",
+            server=self.server_id,
+            level=level,
+            path=path,
+        )
+        return {"level": level}
+
+    async def tree_restore(self, req) -> dict:
+        """Reload the :meth:`tree_checkpoint` for the level the leader
+        names; returns the completed level so the leader re-runs from
+        ``level + 1``.  Requires keys: either still held (transient
+        fault, same process) or re-uploaded via ``add_keys`` after a
+        restart — and refuses a blob written under a different key
+        batch."""
+        if self.ckpt_dir is None:
+            raise RuntimeError("tree_restore: no checkpoint dir configured")
+        path = self._ckpt_path(int(req["level"]))
+        if not os.path.exists(path):
+            raise RuntimeError(f"tree_restore: no checkpoint at {path}")
+        if self._sketch is not None or self._sketch_parts:
+            raise RuntimeError(
+                "malicious-secure crawls are not restorable (see "
+                "tree_checkpoint)"
+            )
+        if self.keys is None:
+            if not self.keys_parts:
+                raise RuntimeError("tree_restore before add_keys")
+            self._concat_keys()
+        with np.load(path) as npz:
+            z = {k: npz[k] for k in npz.files}
+        if not np.array_equal(z["keys_fp"], self._keys_fp()):
+            raise RuntimeError(
+                "tree_restore: checkpoint was written under a different "
+                "key batch — re-upload the original keys"
+            )
+        states = EvalState(
+            seed=jax.device_put(z["seed"]),
+            bit=jax.device_put(z["bit"]),
+            y_bit=jax.device_put(z["y_bit"]),
+        )
+        saved_planar, planar = bool(z["planar"]), collect._expand_engine()
+        if saved_planar != planar:
+            states = (
+                collect.to_interleaved(states)
+                if saved_planar
+                else collect.to_planar(states)
+            )
+        n = self.keys.cw_seed.shape[0]
+        self.alive_keys = np.asarray(z["alive_keys"])
+        if self.alive_keys.shape[0] != n:
+            raise RuntimeError(
+                "tree_restore: checkpoint client count != key batch"
+            )
+        self.frontier = collect.Frontier(
+            states=states, alive=jax.device_put(z["alive"])
+        )
+        self._children = None
+        self._last_shares = None
+        level = int(z["level"])
+        self.obs.count("checkpoint_restores", level=level)
+        obs.emit(
+            "resilience.server_restore", server=self.server_id, level=level
+        )
+        return {"level": level}
+
+    async def plane_reset(self, _req) -> bool:
+        """Re-establish the server↔server data plane after a peer loss.
+
+        Only the DIALER (server 0) acts: it drops the dead transport and
+        redials under the shared backoff policy; the listener's side is
+        re-accepted automatically (``_on_peer`` on its still-bound
+        listener).  Both sides re-run ``_plane_handshake`` on the fresh
+        connection — new sketch-challenge coin flip, new base-OT/IKNP
+        sessions — so the secure exchange is fully re-keyed."""
+        if self.server_id != 0:
+            return True  # listener: re-accept + re-handshake is automatic
+        if self._peer_writer is not None and not self._peer_writer.is_closing():
+            self._peer_writer.close()
+        await self._dial_peer()
+        self.obs.count("plane_resets")
+        obs.emit("resilience.plane_reset", server=self.server_id)
+        return True
+
     # -- wiring ----------------------------------------------------------
 
     _VERBS = (
@@ -673,7 +963,95 @@ class CollectorServer:
         "tree_prune_last",
         "final_shares",
         "sketch_verify",  # the TreeSketchFrontier* verbs' live successor
+        # resilience verbs (no reference analogue)
+        "status",
+        "tree_checkpoint",
+        "tree_restore",
+        "plane_reset",
     )
+
+    def _bind_session(self, req) -> _Session | None:
+        """Create-or-attach the leader session named in a ``__hello__``.
+        Sessions are bounded (oldest-idle evicted) so reconnecting leaders
+        with fresh session ids cannot grow server memory without bound."""
+        sid = (req or {}).get("session")
+        if sid is None:
+            return None
+        sess = self._sessions.get(sid)
+        if sess is None:
+            while len(self._sessions) >= _SESSION_CAP:
+                oldest = min(
+                    self._sessions, key=lambda k: self._sessions[k].last_seen
+                )
+                del self._sessions[oldest]
+            sess = self._sessions[sid] = _Session()
+        epoch = int((req or {}).get("epoch", 0))
+        if epoch > 1:  # epoch 1 is the first connect, not a recovery
+            self.obs.count("session_reconnects")
+            obs.emit(
+                "resilience.session_reconnect",
+                server=self.server_id,
+                epoch=epoch,
+            )
+        sess.epoch = epoch
+        sess.last_seen = time.monotonic()
+        return sess
+
+    async def _dispatch(self, sess: _Session | None, req_id, verb, req):
+        """Run one verb AT MOST ONCE per (session, req_id): replays of a
+        finished verb answer from the bounded response cache; replays of a
+        verb still executing await the same execution.  Errors are
+        responses too — a deterministic rejection must replay as the same
+        rejection, not as a second execution attempt."""
+        if sess is not None:
+            sess.last_seen = time.monotonic()
+            if req_id in sess.cache:
+                self.obs.count("dedup_hits")
+                obs.emit(
+                    "resilience.replay",
+                    severity="debug",
+                    server=self.server_id,
+                    verb=verb,
+                    req_id=req_id,
+                )
+                sess.cache.move_to_end(req_id)
+                return sess.cache[req_id]
+            live = sess.inflight.get(req_id)
+            if live is not None:
+                self.obs.count("dedup_hits")
+                return await asyncio.shield(live)
+            done = sess.inflight[req_id] = (
+                asyncio.get_event_loop().create_future()
+            )
+        try:
+            if verb == "add_keys":  # append-only; no awaits -> atomic
+                resp = await self.add_keys(req)
+            else:
+                async with self._verb_lock:
+                    resp = await getattr(self, verb)(req)
+        # fhh-lint: disable=broad-except (RPC boundary: EVERY failure
+        # mode must surface to the caller as an error response — a
+        # narrowed list would hang the leader on the first unlisted one)
+        except Exception as e:
+            obs.emit(
+                "verb.error", severity="warn", server=self.server_id,
+                verb=verb, error=f"{type(e).__name__}: {e}",
+            )
+            resp = {"__error__": f"{type(e).__name__}: {e}"}
+        except asyncio.CancelledError:
+            # drain-path cancellation: release any replay waiting on this
+            # execution, then propagate
+            if sess is not None:
+                sess.inflight.pop(req_id, None)
+                if not done.done():
+                    done.cancel()
+            raise
+        if sess is not None:
+            sess.put(req_id, resp)
+            sess.inflight.pop(req_id, None)
+            if not done.done():
+                done.set_result(resp)
+        return resp
 
     async def _handle_leader(self, reader, writer):
         """Control-plane serve loop with request ids and concurrent
@@ -683,25 +1061,18 @@ class CollectorServer:
         deserialize and append while others are still on the wire.  Verbs
         that touch the data plane or mutate protocol state serialize on
         ``_verb_lock``; responses carry the id so completion order is
-        free."""
-        write_lock = asyncio.Lock()
+        free.
 
-        async def handle(req_id, verb, req):
-            try:
-                if verb == "add_keys":  # append-only; no awaits -> atomic
-                    resp = await self.add_keys(req)
-                else:
-                    async with self._verb_lock:
-                        resp = await getattr(self, verb)(req)
-            # fhh-lint: disable=broad-except (RPC boundary: EVERY failure
-            # mode must surface to the caller as an error response — a
-            # narrowed list would hang the leader on the first unlisted one)
-            except Exception as e:
-                obs.emit(
-                    "verb.error", severity="warn", server=self.server_id,
-                    verb=verb, error=f"{type(e).__name__}: {e}",
-                )
-                resp = {"__error__": f"{type(e).__name__}: {e}"}
+        A ``__hello__`` frame (sent by the reconnecting client on every
+        connect) binds this connection to a leader session; all later
+        verbs on the connection go through that session's replay dedup
+        (:meth:`_dispatch`).  A client that never says hello gets the
+        legacy at-most-once-per-connection behavior."""
+        write_lock = asyncio.Lock()
+        sess: _Session | None = None
+        self._ctl_writers.add(writer)
+
+        async def respond(req_id, resp):
             try:
                 async with write_lock:
                     await _send(
@@ -717,6 +1088,9 @@ class CollectorServer:
                 if not writer.is_closing():
                     raise
 
+        async def handle(req_id, verb, req):
+            await respond(req_id, await self._dispatch(sess, req_id, verb, req))
+
         tasks = set()
         try:
             while True:
@@ -724,6 +1098,13 @@ class CollectorServer:
                     reader,
                     count=lambda n: self.obs.count("control_bytes_recv", n),
                 )
+                if verb == "__hello__":
+                    sess = self._bind_session(req)
+                    await respond(
+                        req_id,
+                        {"boot_id": self._boot_id, "server_id": self.server_id},
+                    )
+                    continue
                 if verb not in self._VERBS:
                     raise ValueError(f"unknown verb {verb!r}")
                 t = asyncio.create_task(handle(req_id, verb, req))
@@ -767,6 +1148,23 @@ class CollectorServer:
                         t.cancel()
                     break
             writer.close()
+            self._ctl_writers.discard(writer)
+
+    async def aclose(self) -> None:
+        """Tear the whole server down — listeners, leader connections,
+        peer data plane.  In-memory protocol state is NOT cleared: this
+        is process death as far as peers can observe (the chaos tests'
+        kill primitive; a restart is a fresh :class:`CollectorServer`)."""
+        for srv in (getattr(self, "_rpc_srv", None), getattr(self, "_peer_srv", None)):
+            if srv is not None:
+                srv.close()
+                await srv.wait_closed()
+        for w in list(self._ctl_writers):
+            if not w.is_closing():
+                w.close()
+        self._ctl_writers.clear()
+        if self._peer_writer is not None and not self._peer_writer.is_closing():
+            self._peer_writer.close()
 
     @staticmethod
     def _keepalive(writer: asyncio.StreamWriter) -> None:
@@ -786,28 +1184,49 @@ class CollectorServer:
             if hasattr(socket, opt):
                 sock.setsockopt(socket.IPPROTO_TCP, getattr(socket, opt), val)
 
+    async def _dial_peer(self) -> None:
+        """Dial the peer data plane under the shared backoff policy (the
+        reference's connect_with_retries_tcp, server.rs:235, upgraded from
+        fixed sleeps to exponential backoff + full jitter) and run the
+        session handshake on the fresh connection."""
+        peer_host, peer_port = self._peer_addr
+
+        async def dial():
+            return await asyncio.wait_for(
+                asyncio.open_connection(peer_host, peer_port),
+                respolicy.DIAL_TIMEOUT_S,
+            )
+
+        try:
+            r, w = await respolicy.retry_async(
+                dial,
+                respolicy.DIAL_POLICY,
+                what=f"peer data plane {peer_host}:{peer_port}",
+            )
+        except respolicy.TRANSIENT_ERRORS as e:
+            raise ConnectionError(
+                f"peer data-plane unreachable at {peer_host}:{peer_port}: {e!r}"
+            ) from e
+        self._peer_reader, self._peer_writer = r, w
+        self._keepalive(w)
+        await self._plane_handshake()
+
     async def start(self, host: str, port: int, peer_host: str, peer_port: int):
         """Bring up the data plane FIRST (like the reference: GC mesh before
         the RPC listener, server.rs:344-354), run the base-OT handshake if
         the exchange is secure, then serve the leader."""
+        self._peer_addr = (peer_host, peer_port)
         with self.obs.span("setup"):
             if self.server_id == 1:
                 srv = await asyncio.start_server(self._on_peer, host, peer_port)
                 self._peer_ready = asyncio.Event()
                 self._peer_srv = srv
+                # fhh-lint: disable=unbounded-await (startup barrier: a
+                # listening server legitimately waits as long as it takes
+                # its peer to come up; operators bound this externally)
                 await self._peer_ready.wait()
             else:
-                for attempt in range(20):  # connect_with_retries_tcp, server.rs:235
-                    try:
-                        r, w = await asyncio.open_connection(peer_host, peer_port)
-                        break
-                    except OSError:
-                        await asyncio.sleep(0.25)
-                else:
-                    raise ConnectionError("peer data-plane unreachable")
-                self._peer_reader, self._peer_writer = r, w
-                self._keepalive(w)
-                await self._plane_handshake()
+                await self._dial_peer()
             self._rpc_srv = await asyncio.start_server(
                 self._handle_leader, host, port
             )
@@ -869,60 +1288,203 @@ class CollectorServer:
 # ---------------------------------------------------------------------------
 
 
+class ServerRestartedError(ConnectionError):
+    """The reconnect handshake found a DIFFERENT server process (new boot
+    id): in-memory protocol state is gone, so blind verb replay is not
+    safe — the supervising leader must run the restore path (re-upload
+    keys, ``tree_restore``) instead.  Subclasses ConnectionError so
+    non-supervised callers still see it as a connection-shaped failure."""
+
+
 class CollectorClient:
-    """Leader-side RPC stub (the tarpc-generated client analogue).
+    """Leader-side RPC stub (the tarpc-generated client analogue), now
+    RECONNECTING.
 
     The framing carries request ids, so any number of calls may be in
     flight on one connection; a reader task resolves futures by id
     (tarpc's pipelining model, leader.rs:340-364 drives 1000 in-flight
-    addkey batches through it)."""
+    addkey batches through it).
 
-    def __init__(self, reader, writer, reg: obsmetrics.Registry | None = None):
-        self._r, self._w = reader, writer
+    Recovery semantics: the client owns a session id for its lifetime.
+    Every (re)connect sends ``__hello__ {session, epoch}``; on transport
+    loss mid-call, the call redials under ``dial_policy`` (one winner per
+    epoch — concurrent failed calls piggyback on the same redial) and
+    RESENDS its frame with the SAME req_id.  The server's per-session
+    dedup cache answers replays idempotently, so a verb whose response
+    was lost in flight is never double-applied.  Two ways out of the
+    retry loop: the per-verb wall-clock budget (``VerbBudgets``)
+    expires, or the hello discovers a new server boot id
+    (:class:`ServerRestartedError` — replay would run against empty
+    state)."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        reg: obsmetrics.Registry | None = None,
+        *,
+        dial_policy: respolicy.RetryPolicy | None = None,
+        budgets: respolicy.VerbBudgets | None = None,
+    ):
+        self._host, self._port = host, port
+        self._r = self._w = None
         self._send_lock = asyncio.Lock()
+        self._conn_lock = asyncio.Lock()
         self._pending: dict[int, asyncio.Future] = {}
         self._next_id = 0
+        self._reader_task: asyncio.Task | None = None
+        self._dead: ConnectionError | None = None
+        self.session_id = _secrets.token_hex(8)
+        self.epoch = 0  # successful connects; >1 means we have reconnected
+        self.boot_id: str | None = None  # server identity from last hello
+        self.dial_policy = dial_policy or respolicy.DIAL_POLICY
+        self.budgets = budgets or respolicy.VerbBudgets()
         # control-plane byte accounting lands on the leader process's
         # default registry unless the caller owns one
         self.obs = obsmetrics.default_registry() if reg is None else reg
-        self._reader_task = asyncio.ensure_future(self._read_loop())
 
     @classmethod
-    async def connect(cls, host: str, port: int, retries: int = 40):
-        for _ in range(retries):
-            try:
-                r, w = await asyncio.open_connection(host, port)
-                return cls(r, w)
-            except OSError:
-                await asyncio.sleep(0.25)
-        raise ConnectionError(f"server {host}:{port} unreachable")
+    async def connect(cls, host: str, port: int, **kw) -> "CollectorClient":
+        c = cls(host, port, **kw)
+        await c._ensure_connected(0)
+        return c
 
-    async def _read_loop(self):
+    async def aclose(self) -> None:
+        self._dead = ConnectionError("client closed")
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        if self._w is not None and not self._w.is_closing():
+            self._w.close()
+        self._fail_pending(self._dead)
+
+    def _fail_pending(self, err: ConnectionError) -> None:
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(err)
+        self._pending.clear()
+
+    async def _ensure_connected(self, seen_epoch: int) -> None:
+        """(Re)dial unless someone already did since ``seen_epoch`` (the
+        epoch the caller last observed).  All concurrently-failed calls
+        funnel here; the first through the lock redials, the rest find a
+        fresh epoch and just resend."""
+        if self._dead is not None:
+            raise self._dead
+        async with self._conn_lock:
+            if (
+                self.epoch > seen_epoch
+                and self._w is not None
+                and not self._w.is_closing()
+            ):
+                return  # a concurrent caller already reconnected
+
+            async def dial():
+                return await asyncio.wait_for(
+                    asyncio.open_connection(self._host, self._port),
+                    respolicy.DIAL_TIMEOUT_S,
+                )
+
+            try:
+                r, w = await respolicy.retry_async(
+                    dial,
+                    self.dial_policy,
+                    what=f"dial {self._host}:{self._port}",
+                )
+            except respolicy.TRANSIENT_ERRORS as e:
+                # NOT permanent: a later call (e.g. the supervisor's next
+                # recovery wave) may find the server back up and redial
+                err = ConnectionError(
+                    f"server {self._host}:{self._port} unreachable: {e!r}"
+                )
+                self._fail_pending(err)
+                raise err from e
+            if self._reader_task is not None:
+                self._reader_task.cancel()
+            if self._w is not None and not self._w.is_closing():
+                # close the superseded transport: after a peer FIN (or a
+                # reader death that left TCP up, e.g. a corrupt frame)
+                # the old fd would otherwise sit in CLOSE_WAIT — and the
+                # server would keep a zombie handler bound to it — for
+                # the life of the process, one leak per reconnect
+                self._w.close()
+            # any future still pending belongs to the OLD transport: its
+            # response can never arrive — fail it so its owner replays on
+            # the fresh epoch instead of waiting out its whole budget
+            self._fail_pending(
+                ConnectionError("transport replaced by reconnect")
+            )
+            self._r, self._w = r, w
+            self.epoch += 1
+            self._reader_task = asyncio.ensure_future(self._read_loop(r))
+            # session handshake: bind this connection to our session (the
+            # server arms replay dedup) and learn the server's boot id
+            self._next_id += 1
+            hello = await self._roundtrip(
+                self._next_id,
+                "__hello__",
+                {"session": self.session_id, "epoch": self.epoch},
+                respolicy.Deadline(self.budgets.budget("__hello__")),
+            )
+            new_boot = hello.get("boot_id")
+            old_boot, self.boot_id = self.boot_id, new_boot
+            if self.epoch > 1:
+                self.obs.count("reconnects")
+                obs.emit(
+                    "resilience.reconnect",
+                    host=self._host,
+                    port=self._port,
+                    epoch=self.epoch,
+                    restarted=bool(old_boot and old_boot != new_boot),
+                )
+
+    async def _roundtrip(self, req_id, verb, req, deadline: respolicy.Deadline):
+        """One send + response wait on the CURRENT transport (no retry —
+        :meth:`call` owns the retry loop, and owns the req_id: a REPLAY
+        must reuse the original id or the server's dedup cache can never
+        recognize it)."""
+        if (
+            self._w is None
+            or self._w.is_closing()
+            or self._reader_task is None
+            or self._reader_task.done()
+        ):
+            # transport already known-dead: a write might still "succeed"
+            # locally (FIN'd socket) and the dead reader would never
+            # resolve the future — fail fast into the reconnect path
+            # instead of waiting out the whole verb budget
+            raise ConnectionError("transport down")
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[req_id] = fut
+        try:
+            async with self._send_lock:
+                await _send(
+                    self._w, (req_id, verb, req or {}),
+                    count=lambda n: self.obs.count("control_bytes_sent", n),
+                )
+            return await deadline.wait_for(fut)
+        finally:
+            # send raised mid-write, the wait timed out, or the reader
+            # failed the future: either way the response slot is dead —
+            # drop it so _pending can't grow across failed calls
+            self._pending.pop(req_id, None)
+
+    async def _read_loop(self, reader):
         try:
             while True:
                 req_id, resp = await _recv(
-                    self._r,
+                    reader,
                     count=lambda n: self.obs.count("control_bytes_recv", n),
                 )
                 fut = self._pending.pop(req_id, None)
                 if fut is not None and not fut.done():
                     fut.set_result(resp)
-        except Exception as e:  # any reader death fails every caller loudly
-            self._dead = ConnectionError(f"connection lost: {e!r}")
+        except Exception as e:  # reader death fails every in-flight caller
+            err = ConnectionError(f"connection lost: {e!r}")
             for fut in self._pending.values():
                 if not fut.done():
-                    fut.set_exception(ConnectionError(f"connection lost: {e!r}"))
+                    fut.set_exception(err)
             self._pending.clear()
-            if not isinstance(
-                e,
-                (
-                    asyncio.IncompleteReadError,  # clean peer close / EOF
-                    ConnectionError,
-                    EOFError,
-                    OSError,
-                    pickle.UnpicklingError,  # corrupt frame = transport loss
-                ),
-            ):
+            if not isinstance(e, respolicy.TRANSIENT_ERRORS):
                 # anything else is a BUG in this client, not a transport
                 # death.  Emit it NOW — nothing awaits the reader task, so
                 # a bare re-raise would sit unretrieved until GC — then
@@ -934,18 +1496,44 @@ class CollectorClient:
                 raise
 
     async def call(self, verb: str, req=None):
-        if getattr(self, "_dead", None) is not None:
+        """At-most-once verb call with transparent replay: transient
+        transport failures redial and resend the SAME req_id (the server
+        dedups); the per-verb wall-clock budget bounds the whole affair —
+        every redial, every replay, and the server's execution."""
+        if self._dead is not None:
             raise self._dead
+        deadline = self.budgets.deadline(verb)
+        first_boot = self.boot_id
+        payload = req or {}
         self._next_id += 1
-        req_id = self._next_id
-        fut = asyncio.get_event_loop().create_future()
-        self._pending[req_id] = fut
-        async with self._send_lock:
-            await _send(
-                self._w, (req_id, verb, req or {}),
-                count=lambda n: self.obs.count("control_bytes_sent", n),
-            )
-        resp = await fut
+        req_id = self._next_id  # ONE id for the call's lifetime: replays
+        resp = None             # reuse it so the server can dedup them
+        while True:
+            seen_epoch = self.epoch
+            try:
+                resp = await self._roundtrip(req_id, verb, payload, deadline)
+                break
+            except respolicy.TRANSIENT_ERRORS as e:
+                if deadline.expired():
+                    raise TimeoutError(
+                        f"verb {verb!r} exceeded its "
+                        f"{self.budgets.budget(verb):g}s budget "
+                        f"(last error: {type(e).__name__}: {e})"
+                    ) from e
+                self.obs.count("call_retries")
+                obs.emit(
+                    "resilience.call_retry",
+                    severity="debug",
+                    verb=verb,
+                    epoch=seen_epoch,
+                    error=f"{type(e).__name__}: {e}",
+                )
+                await self._ensure_connected(seen_epoch)
+                if first_boot is not None and self.boot_id != first_boot:
+                    raise ServerRestartedError(
+                        f"server {self._host}:{self._port} restarted while "
+                        f"{verb!r} was in flight — state lost, replay unsafe"
+                    ) from e
         if isinstance(resp, dict) and "__error__" in resp:
             raise RuntimeError(f"server error on {verb}: {resp['__error__']}")
         return resp

@@ -1,0 +1,43 @@
+"""Fault-tolerance layer for the distributed crawl.
+
+Three pieces, shared by the control plane (leader↔server RPC), the data
+plane (server↔server socket), and the leader's crawl supervision:
+
+- :mod:`.policy` — the ONE retry/deadline vocabulary: exponential
+  backoff with full jitter (:class:`RetryPolicy`), wall-clock budgets
+  (:class:`Deadline`), per-verb budget tables (:class:`VerbBudgets`),
+  and the transient-vs-fatal error classifier every retry loop consults
+  (:func:`is_transient`).  Replaces the fixed-sleep dial loops that used
+  to live in protocol/rpc.py.
+- :mod:`.chaos` — a frame-aware fault-injection proxy for recovery
+  tests: sits between leader↔server or server↔server sockets and
+  severs, delays, black-holes, or truncates frames on a deterministic
+  ``FHH_FAULTS`` schedule (grammar in :func:`chaos.parse_faults`).
+- the reconnecting client + idempotent verb replay live in
+  protocol/rpc.py itself (they ARE the transport), built on this
+  module's policy vocabulary; leader-side crawl supervision lives in
+  protocol/leader_rpc.py (:meth:`RpcLeader.run_supervised`).
+
+Every recovery event emits ``resilience.*`` telemetry: retry counts,
+reconnect epochs, replayed verbs, restored/re-run levels.
+"""
+
+from .chaos import ChaosProxy, FaultSpec, parse_faults
+from .policy import (
+    Deadline,
+    RetryPolicy,
+    VerbBudgets,
+    is_transient,
+    retry_async,
+)
+
+__all__ = [
+    "ChaosProxy",
+    "Deadline",
+    "FaultSpec",
+    "RetryPolicy",
+    "VerbBudgets",
+    "is_transient",
+    "parse_faults",
+    "retry_async",
+]

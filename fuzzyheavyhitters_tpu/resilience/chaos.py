@@ -1,0 +1,310 @@
+"""Chaos proxy: deterministic fault injection between crawl sockets.
+
+An asyncio TCP proxy that understands the control/data-plane framing
+(8-byte little-endian length prefix, protocol/rpc.py ``_HDR``) and can
+therefore trigger faults at exact FRAME boundaries — "sever the leader's
+link right after the 12th request" is reproducible, where byte- or
+time-triggered faults are not.
+
+Fault grammar (the ``FHH_FAULTS`` env spec; ';'-separated clauses)::
+
+    <link>:<action>@msg=<N>[,key=value...]
+
+    link    label the proxy was constructed with (e.g. ctl0, ctl1, plane)
+    action  sever | delay | blackhole | truncate
+    msg=N   fire when the Nth frame (1-indexed, per direction) arrives
+    dir=    c2s (default) | s2c — which direction's frame counter triggers
+    ms=M    delay: forward the frame M milliseconds late (default 200)
+    count=K blackhole: drop K consecutive frames then resume (default 1;
+            sever/truncate ignore it — the connection is gone after one)
+
+Actions:
+
+- ``sever``     — close both sides mid-stream (RST-ish: the peer sees a
+  reset/EOF).  The listener stays up: a reconnecting client redials
+  through the same proxy and gets a clean new pipe.
+- ``delay``     — hold one frame for ``ms`` before forwarding (tests
+  deadline headroom without killing anything).
+- ``blackhole`` — read and DROP ``count`` frames silently; the
+  connection stays open (tests the per-verb wall-clock budgets: the
+  caller must time out rather than hang forever).
+- ``truncate``  — forward only half of the frame's payload bytes, then
+  sever (tests the torn-frame path: the reader must classify the
+  corrupt/short frame as transport loss, not crash).
+
+Each accepted connection gets an independent pump per direction.  Frame
+ORDINALS are per connection and per direction (deterministic: TCP orders
+each direction), but the fault clauses themselves are consumed
+PROXY-GLOBALLY — a sever that fired once does not re-arm on the redial
+(otherwise a reconnecting client would be severed at the same ordinal of
+every fresh connection, forever).  Chain clauses for multi-fault
+schedules; ``ChaosProxy.sever_now()`` gives imperative test control.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from dataclasses import dataclass, field
+
+from .. import obs
+
+_HDR = struct.Struct("<Q")  # mirror protocol/rpc.py framing
+
+_ACTIONS = ("sever", "delay", "blackhole", "truncate")
+_DIRS = ("c2s", "s2c")
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    link: str
+    action: str
+    at_msg: int  # 1-indexed frame ordinal that triggers the fault
+    direction: str = "c2s"
+    ms: int = 200
+    count: int = 1
+
+    def __post_init__(self):
+        if self.action not in _ACTIONS:
+            raise ValueError(f"unknown chaos action {self.action!r}")
+        if self.direction not in _DIRS:
+            raise ValueError(f"unknown chaos direction {self.direction!r}")
+        if self.at_msg < 1:
+            raise ValueError("msg= trigger is 1-indexed")
+
+
+def parse_faults(spec: str) -> list[FaultSpec]:
+    """Parse an ``FHH_FAULTS`` spec string (grammar above).  Empty/blank
+    specs parse to no faults; malformed clauses raise ValueError loudly —
+    a chaos schedule that silently no-ops would make a recovery test pass
+    for the wrong reason."""
+    out: list[FaultSpec] = []
+    for clause in (spec or "").split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        try:
+            head, args = clause.split("@", 1)
+            link, action = head.split(":", 1)
+        except ValueError:
+            raise ValueError(
+                f"bad chaos clause {clause!r} (want link:action@msg=N[,k=v...])"
+            ) from None
+        kw: dict = {}
+        for part in args.split(","):
+            k, _, v = part.partition("=")
+            k, v = k.strip(), v.strip()
+            if k == "msg":
+                kw["at_msg"] = int(v)
+            elif k == "dir":
+                kw["direction"] = v
+            elif k in ("ms", "count"):
+                kw[k] = int(v)
+            else:
+                raise ValueError(f"unknown chaos arg {k!r} in {clause!r}")
+        if "at_msg" not in kw:
+            raise ValueError(f"chaos clause {clause!r} missing msg= trigger")
+        out.append(FaultSpec(link=link.strip(), action=action.strip(), **kw))
+    return out
+
+
+class ChaosProxy:
+    """One listener forwarding to one target, applying the fault clauses
+    whose ``link`` matches this proxy's label.
+
+    Construct, ``await start()``, point the client at ``listen_port``.
+    The proxy survives severs (the listener stays bound) so reconnect
+    paths are exercised end-to-end through the same chokepoint.
+    """
+
+    def __init__(
+        self,
+        listen_host: str,
+        listen_port: int,
+        target_host: str,
+        target_port: int,
+        faults: list[FaultSpec] | None = None,
+        link: str = "link",
+    ):
+        self.listen_host, self.listen_port = listen_host, listen_port
+        self.target_host, self.target_port = target_host, target_port
+        self.link = link
+        self.faults = [f for f in (faults or []) if f.link == link]
+        self._srv: asyncio.AbstractServer | None = None
+        self._conns: set[tuple] = set()
+        self._pumps: set[asyncio.Task] = set()
+        # armed faults are consumed proxy-globally: [spec, remaining_fires]
+        self._armed: list[list] = [
+            [f, f.count if f.action == "blackhole" else 1]
+            for f in self.faults
+        ]
+        self.frames = {"c2s": 0, "s2c": 0}  # lifetime totals, all conns
+        self.fired: list[tuple[str, str, int]] = []  # (action, dir, msg#)
+
+    async def start(self) -> "ChaosProxy":
+        self._srv = await asyncio.start_server(
+            self._on_client, self.listen_host, self.listen_port
+        )
+        return self
+
+    async def stop(self) -> None:
+        if self._srv is not None:
+            self._srv.close()
+            await self._srv.wait_closed()
+        self.sever_now()
+        for t in list(self._pumps):
+            t.cancel()
+        for t in list(self._pumps):
+            try:
+                await t
+            # fhh-lint: disable=broad-except (teardown: a pump dying of
+            # ANY error while being torn down is expected, not reportable)
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    def sever_now(self) -> None:
+        """Imperatively cut every live connection (keeps listening)."""
+        for pair in list(self._conns):
+            for w in pair:
+                if not w.is_closing():
+                    w.close()
+        self._conns.clear()
+
+    # -- internals --------------------------------------------------------
+
+    async def _on_client(self, c_reader, c_writer):
+        try:
+            s_reader, s_writer = await asyncio.wait_for(
+                asyncio.open_connection(self.target_host, self.target_port),
+                5.0,
+            )
+        except (OSError, asyncio.TimeoutError):
+            c_writer.close()
+            return
+        pair = (c_writer, s_writer)
+        self._conns.add(pair)
+        state = _ConnState(self)
+        for direction, rd, wr in (
+            ("c2s", c_reader, s_writer),
+            ("s2c", s_reader, c_writer),
+        ):
+            t = asyncio.create_task(self._pump(state, direction, rd, wr, pair))
+            self._pumps.add(t)
+            t.add_done_callback(self._pumps.discard)
+
+    def _sever_pair(self, pair) -> None:
+        for w in pair:
+            if not w.is_closing():
+                w.close()
+        self._conns.discard(pair)
+
+    async def _pump(self, state, direction, reader, writer, pair):
+        """Forward frames one at a time, consulting the schedule at each
+        frame boundary.  Any transport error on either side ends the pump
+        (and severs the pair: half-open proxies would hide real severs)."""
+        try:
+            while True:
+                # fhh-lint: disable=unbounded-await (proxy pump: a chaos
+                # proxy must never impose its own deadline — the system
+                # under test owns all timeout behavior)
+                hdr = await reader.readexactly(_HDR.size)
+                (n,) = _HDR.unpack(hdr)
+                # fhh-lint: disable=unbounded-await (as above)
+                body = await reader.readexactly(n)
+                msg_no = state.next_msg(direction)
+                self.frames[direction] += 1
+                fault = state.fault_for(direction, msg_no)
+                if fault is not None:
+                    self.fired.append((fault.action, direction, msg_no))
+                    obs.emit(
+                        "resilience.chaos_fired",
+                        severity="debug",
+                        link=self.link,
+                        action=fault.action,
+                        direction=direction,
+                        msg=msg_no,
+                    )
+                    if fault.action == "sever":
+                        self._sever_pair(pair)
+                        return
+                    if fault.action == "blackhole":
+                        continue  # drop the frame; connection stays up
+                    if fault.action == "truncate":
+                        writer.write(hdr + body[: max(1, n // 2)])
+                        await writer.drain()
+                        self._sever_pair(pair)
+                        return
+                    if fault.action == "delay":
+                        await asyncio.sleep(fault.ms / 1000.0)
+                writer.write(hdr + body)
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError, OSError):
+            self._sever_pair(pair)
+
+
+class _ConnState:
+    """Per-connection frame counters; fault consumption lives on the
+    proxy (``_armed``) so a fired fault stays fired across redials.  A
+    blackhole of ``count=K`` drops the next K frames matching its
+    direction once its trigger ordinal is reached."""
+
+    def __init__(self, proxy: ChaosProxy):
+        self.counts = {"c2s": 0, "s2c": 0}
+        self._proxy = proxy
+
+    def next_msg(self, direction: str) -> int:
+        self.counts[direction] += 1
+        return self.counts[direction]
+
+    def fault_for(self, direction: str, msg_no: int) -> FaultSpec | None:
+        for ent in self._proxy._armed:
+            f, remaining = ent
+            if remaining <= 0 or f.direction != direction:
+                continue
+            if msg_no >= f.at_msg:
+                ent[1] -= 1
+                return f
+        return None
+
+
+@dataclass
+class ChaosLinks:
+    """Convenience bundle for the standard three-link topology: leader→s0,
+    leader→s1, s0→s1 data plane — built from one ``FHH_FAULTS`` string.
+    ``await start()`` brings all three up; address helpers give the
+    through-proxy endpoints the leader/server configs should dial."""
+
+    listen_host: str
+    base_port: int  # three consecutive ports: ctl0, ctl1, plane
+    ctl0_target: tuple[str, int]
+    ctl1_target: tuple[str, int]
+    plane_target: tuple[str, int]
+    faults: list[FaultSpec] = field(default_factory=list)
+    proxies: dict = field(default_factory=dict)
+
+    async def start(self) -> "ChaosLinks":
+        for i, (link, tgt) in enumerate(
+            (
+                ("ctl0", self.ctl0_target),
+                ("ctl1", self.ctl1_target),
+                ("plane", self.plane_target),
+            )
+        ):
+            p = ChaosProxy(
+                self.listen_host,
+                self.base_port + i,
+                tgt[0],
+                tgt[1],
+                self.faults,
+                link=link,
+            )
+            self.proxies[link] = await p.start()
+        return self
+
+    async def stop(self) -> None:
+        for p in self.proxies.values():
+            await p.stop()
+
+    def addr(self, link: str) -> tuple[str, int]:
+        order = ("ctl0", "ctl1", "plane")
+        return self.listen_host, self.base_port + order.index(link)

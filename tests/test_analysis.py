@@ -357,6 +357,78 @@ def test_bare_print_detected_and_scoped():
 
 
 # ---------------------------------------------------------------------------
+# rule: unbounded-await
+# ---------------------------------------------------------------------------
+
+
+def test_unbounded_await_reads_and_waits_detected():
+    src = """
+    import asyncio
+
+    async def f(reader, ev, tasks):
+        hdr = await reader.readexactly(8)
+        line = await reader.readline()
+        await ev.wait()
+        done, pending = await asyncio.wait(tasks)
+    """
+    found = _lint(src, rule="unbounded-await")
+    assert len(found) == 4
+    assert all(f.rule == "unbounded-await" for f in found)
+
+
+def test_unbounded_await_dial_and_disguised_wait_for_detected():
+    src = """
+    import asyncio
+
+    async def f(fut):
+        r, w = await asyncio.open_connection("h", 1)
+        await asyncio.wait_for(fut, None)
+        await asyncio.wait_for(fut, timeout=None)
+    """
+    found = _lint(src, rule="unbounded-await")
+    assert len(found) == 3
+
+
+def test_unbounded_await_bounded_forms_clean():
+    src = """
+    import asyncio
+
+    async def f(reader, tasks, fut, deadline):
+        hdr = await asyncio.wait_for(reader.readexactly(8), 5.0)
+        done, pending = await asyncio.wait(tasks, timeout=30)
+        resp = await asyncio.wait_for(fut, deadline.remaining())
+        body = await reader.read(n, timeout=2.0)
+        return await fut  # awaiting a plain future is not a net call
+    """
+    assert _lint(src, rule="unbounded-await") == []
+
+
+def test_unbounded_await_scoped_to_transport_modules():
+    src = """
+    async def f(reader):
+        return await reader.readexactly(8)
+    """
+    assert len(_lint(src, rule="unbounded-await")) == 1
+    assert _lint(
+        src, "fuzzyheavyhitters_tpu/resilience/fake.py", rule="unbounded-await"
+    )  # resilience is transport scope too
+    assert _lint(
+        src, "fuzzyheavyhitters_tpu/ops/fake.py", rule="unbounded-await"
+    ) == []
+    assert _lint(src, "tests/test_x.py", rule="unbounded-await") == []
+
+
+def test_unbounded_await_suppression():
+    src = """
+    async def f(reader):
+        # fhh-lint: disable=unbounded-await (serve loop: waits for the
+        # next command by design)
+        return await reader.readexactly(8)
+    """
+    assert _lint(src, rule="unbounded-await") == []
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -567,7 +639,7 @@ def test_pyproject_and_dataclass_defaults_do_not_drift():
     for key in (
         "hot_modules", "hot_roots", "secret_lexicon", "sink_calls",
         "print_scope", "print_allowed", "shared_state_modules",
-        "default_paths", "baseline",
+        "await_modules", "default_paths", "baseline",
     ):
         assert getattr(operative, key) == getattr(defaults, key), key
 
@@ -611,6 +683,7 @@ def test_every_rule_has_fixture_coverage():
         "unguarded-shared-state",
         "broad-except",
         "bare-print",
+        "unbounded-await",
     }
     assert {r.name for r in ALL_RULES} == covered
 

@@ -125,3 +125,149 @@ def test_reset_clears_state(rng):
         return True
 
     assert asyncio.run(flow())
+
+
+# ---------------------------------------------------------------------------
+# failure paths the resilience layer builds on
+# ---------------------------------------------------------------------------
+
+
+def test_error_response_propagates_and_connection_survives(rng):
+    """A verb that fails server-side comes back as an __error__ response
+    raising RuntimeError at the caller — and the connection stays usable
+    (the error is a RESPONSE, not a transport death)."""
+    port = 39231
+
+    async def flow():
+        cfg = _cfg(
+            server0=f"127.0.0.1:{port}", server1=f"127.0.0.1:{port + 10}"
+        )
+        s0 = rpc.CollectorServer(0, cfg)
+        s1 = rpc.CollectorServer(1, cfg)
+        t1 = asyncio.create_task(
+            s1.start("127.0.0.1", port + 10, "127.0.0.1", port + 11)
+        )
+        await asyncio.sleep(0.05)
+        t0 = asyncio.create_task(
+            s0.start("127.0.0.1", port, "127.0.0.1", port + 11)
+        )
+        await asyncio.gather(t0, t1)
+        c0 = await rpc.CollectorClient.connect("127.0.0.1", port)
+        with pytest.raises(RuntimeError, match="tree_init before add_keys"):
+            await c0.call("tree_init")
+        # protocol errors are NOT retried (they would never succeed) and
+        # the transport survives them
+        assert c0.epoch == 1
+        assert await c0.call("reset") is True
+        await c0.aclose()
+        await s0.aclose()
+        await s1.aclose()
+
+    asyncio.run(flow())
+
+
+def test_read_loop_death_fails_inflight_futures():
+    """Reader death must fail EVERY in-flight caller loudly (no future
+    left dangling), and once redials exhaust, the call surfaces a
+    ConnectionError — with the pending table empty (the send-failure /
+    reader-death paths may not leak futures)."""
+    port = 39251
+
+    async def flow():
+        conns = []
+
+        async def half_server(reader, writer):
+            # answer the hello, then die mid-protocol without responding
+            req_id, verb, req = await rpc._recv(reader)
+            assert verb == "__hello__"
+            await rpc._send(writer, (req_id, {"boot_id": "fake"}))
+            conns.append((reader, writer))
+            await rpc._recv(reader)  # swallow one verb frame...
+            writer.close()  # ...and hang up without answering
+
+        srv = await asyncio.start_server(half_server, "127.0.0.1", port)
+        from fuzzyheavyhitters_tpu.resilience import policy as respolicy
+
+        c = await rpc.CollectorClient.connect(
+            "127.0.0.1", port,
+            dial_policy=respolicy.RetryPolicy(
+                base_s=0.001, attempts=2, rand=lambda: 0.0
+            ),
+            budgets=respolicy.VerbBudgets(default_s=5.0, per_verb={}),
+        )
+        srv.close()  # no more accepts: redials must exhaust
+        await srv.wait_closed()
+        with pytest.raises(ConnectionError):
+            await c.call("reset")
+        assert c._pending == {}  # nothing leaked across the failed call
+        await c.aclose()
+
+    asyncio.run(flow())
+
+
+def test_send_failure_pops_pending():
+    """The _send-raises-mid-write path: the pending future is dropped so
+    _pending cannot grow across failed calls (it used to leak one entry
+    per failure), and a non-transport bug propagates unretried."""
+    port = 39261
+
+    async def flow():
+        async def hello_only(reader, writer):
+            req_id, verb, _ = await rpc._recv(reader)
+            await rpc._send(writer, (req_id, {"boot_id": "fake"}))
+
+        srv = await asyncio.start_server(hello_only, "127.0.0.1", port)
+        c = await rpc.CollectorClient.connect("127.0.0.1", port)
+
+        class Boom(Exception):
+            pass
+
+        real_send = rpc._send
+
+        async def broken_send(writer, obj, count=None):
+            raise Boom("pickling exploded mid-write")
+
+        rpc._send = broken_send
+        try:
+            with pytest.raises(Boom):
+                await c.call("reset")
+        finally:
+            rpc._send = real_send
+        assert c._pending == {}
+        await c.aclose()
+        srv.close()
+        await srv.wait_closed()
+
+    asyncio.run(flow())
+
+
+def test_keepalive_sets_socket_options():
+    """_keepalive arms SO_KEEPALIVE with the aggressive-ish probe timing
+    on the data-plane socket (a silently-dead peer surfaces in ~2 min,
+    not the kernel's ~2 h default)."""
+    import socket
+
+    port = 39271
+
+    async def flow():
+        async def server(reader, writer):
+            await asyncio.sleep(0.2)
+            writer.close()
+
+        srv = await asyncio.start_server(server, "127.0.0.1", port)
+        _, w = await asyncio.open_connection("127.0.0.1", port)
+        rpc.CollectorServer._keepalive(w)
+        sock = w.get_extra_info("socket")
+        assert sock.getsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE) == 1
+        for opt, want in (
+            ("TCP_KEEPIDLE", 60), ("TCP_KEEPINTVL", 20), ("TCP_KEEPCNT", 3)
+        ):
+            if hasattr(socket, opt):
+                assert sock.getsockopt(
+                    socket.IPPROTO_TCP, getattr(socket, opt)
+                ) == want
+        w.close()
+        srv.close()
+        await srv.wait_closed()
+
+    asyncio.run(flow())
