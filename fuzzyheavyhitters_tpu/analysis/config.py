@@ -80,9 +80,11 @@ class LintConfig:
     )
     # unbounded-await rule: transport modules where every await on a
     # network read / event wait / dial must carry a timeout or deadline
+    # (parallel/ included: mesh-transport awaits are transport awaits)
     await_modules: tuple = (
         "fuzzyheavyhitters_tpu/protocol",
         "fuzzyheavyhitters_tpu/resilience",
+        "fuzzyheavyhitters_tpu/parallel",
     )
     severity_overrides: dict = field(default_factory=dict)
     baseline: str = "lint_baseline.json"

@@ -120,16 +120,16 @@ async def _run(cfg, nreqs: int, rng) -> None:
     c1 = await CollectorClient.connect(h1, p1)
 
     lead = RpcLeader(cfg, c0, c1)
-    # supervised crawl (FHH_SUPERVISE=0 opts out; malicious mode cannot
-    # roll back — see RpcLeader.run_supervised — so it keeps the plain
-    # path): the leader checkpoints every FHH_CKPT_EVERY levels and, on
-    # any transport loss or server restart, restores both servers and
-    # re-runs only the lost levels
-    supervise = os.environ.get("FHH_SUPERVISE", "1") != "0" and not cfg.malicious
+    # supervised crawl (FHH_SUPERVISE=0 opts out), malicious mode
+    # included — the per-level challenge ratchet makes sketch crawls
+    # restartable (see protocol/sketch.py): the leader checkpoints every
+    # FHH_CKPT_EVERY levels and, on any transport loss or server restart,
+    # restores both servers and re-runs only the lost levels
+    supervise = os.environ.get("FHH_SUPERVISE", "1") != "0"
     t0 = time.perf_counter()
     if supervise:
         res = await lead.run_supervised(
-            nreqs, k0, k1,
+            nreqs, k0, k1, sk0, sk1,
             checkpoint_every=int(os.environ.get("FHH_CKPT_EVERY", "16")),
         )
     else:

@@ -32,6 +32,13 @@ Well-known metric names (what populates them):
   ``checkpoint_writes`` / ``checkpoint_restores``.
 - gauges ``ot_batch_size`` (per level), ``survivors`` /
   ``frontier_nodes`` (per level).
+- counters ``recoveries`` / ``levels_rerun`` / ``shards_rerun``
+  (supervising leaders, socket and mesh) and ``dedup_hits`` /
+  ``verb_requests`` (servers' idempotent-replay accounting) — rolled up
+  across registries into a top-level ``recovery`` section
+  (``{count, levels_rerun, shards_rerun, dedup_hits, dedup_hit_rate}``)
+  whenever any supervised component ran, so a recovered run is
+  distinguishable from a fault-free one in the report alone.
 
 ``FHH_RUN_REPORT=<path>`` makes the binaries (and bench) write the
 report there at exit / on SIGTERM; :func:`maybe_write_run_report` is
@@ -88,9 +95,46 @@ def run_report(registries=None) -> dict:
         "written_at": round(time.time(), 3),
         "registries": out,
     }
+    rec = _recovery_summary(out)
+    if rec is not None:
+        doc["recovery"] = rec
     if dropped:
         doc["dropped_registries"] = dropped
     return doc
+
+
+def _recovery_summary(registries: dict) -> dict | None:
+    """Cross-registry recovery rollup: a RECOVERED run must be
+    distinguishable from a fault-free one in the report alone.  Sums the
+    supervisor counters (``recoveries`` / ``levels_rerun`` /
+    ``shards_rerun``) and the servers' replay-dedup accounting
+    (``dedup_hits`` over ``verb_requests`` -> hit rate) across every
+    registry.  Present whenever any of those counters exists — a
+    supervised fault-free run reports zeros, an unsupervised legacy run
+    omits the section entirely."""
+    names = (
+        "recoveries", "levels_rerun", "shards_rerun",
+        "dedup_hits", "verb_requests",
+    )
+    sums = dict.fromkeys(names, 0)
+    seen = False
+    for snap in registries.values():
+        counters = snap.get("counters", {})
+        for n in names:
+            if n in counters:
+                seen = True
+                sums[n] += counters[n].get("total", 0)
+    if not seen:
+        return None
+    return {
+        "count": sums["recoveries"],
+        "levels_rerun": sums["levels_rerun"],
+        "shards_rerun": sums["shards_rerun"],
+        "dedup_hits": sums["dedup_hits"],
+        "dedup_hit_rate": round(
+            sums["dedup_hits"] / max(1, sums["verb_requests"]), 6
+        ),
+    }
 
 
 def write_run_report(path: str, registries=None) -> dict:

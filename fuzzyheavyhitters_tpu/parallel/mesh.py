@@ -32,6 +32,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.4.31 exposes shard_map at the top level; 0.4.x keeps it
+    _shard_map = jax.shard_map  # under experimental — accept both so the
+except AttributeError:  # mesh path runs on every baked-in runtime
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from ..obs import metrics as obsmetrics
 from ..ops import baseot, gc, otext, prg
 from ..ops.fields import F255, FE62
@@ -296,7 +301,7 @@ class MeshRunner:
 
         # fhh-lint: disable=recompile-churn (setup-time factory: built once per mesh)
         self._init_fn = jax.jit(
-            jax.shard_map(init_body, mesh=mesh, in_specs=(kspec,), out_specs=fspec)
+            _shard_map(init_body, mesh=mesh, in_specs=(kspec,), out_specs=fspec)
         )
 
         def make_counts_fn(want_children: bool):
@@ -322,7 +327,7 @@ class MeshRunner:
 
             # fhh-lint: disable=recompile-churn (setup-time factory: built once per mesh)
             return jax.jit(
-                jax.shard_map(
+                _shard_map(
                     counts_body,
                     mesh=mesh,
                     in_specs=(kspec, fspec, P(SERVERS, DATA), P()),
@@ -340,7 +345,7 @@ class MeshRunner:
 
         # fhh-lint: disable=recompile-churn (setup-time factory: built once per mesh)
         self._advance_fn = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 advc_body,
                 mesh=mesh,
                 in_specs=(cspec, P(None), P(None, None), P()),
@@ -478,7 +483,7 @@ class MeshRunner:
 
         # fhh-lint: disable=recompile-churn (setup-time factory: built once per mesh)
         fn = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 body,
                 mesh=mesh,
                 in_specs=(
@@ -576,6 +581,43 @@ class MeshRunner:
         )
         self._children = None
 
+    # -- checkpoint / restore (data-plane fault tolerance) ------------------
+
+    def snapshot(self) -> dict:
+        """Host-side snapshot of the device-resident crawl state — the
+        mesh twin of the socket servers' ``tree_checkpoint`` blob.  ONE
+        stacked ``device_get`` (each fetch through a remote-chip tunnel
+        is a full round trip); keys are NOT included (the caller holds
+        them, and they never change mid-crawl)."""
+        assert self.frontier is not None, "snapshot before tree_init"
+        st = self.frontier.states
+        return jax.device_get(
+            {
+                "seed": st.seed,
+                "bit": st.bit,
+                "y_bit": st.y_bit,
+                "alive": self.frontier.alive,
+                "alive_keys": self.alive_keys,
+            }
+        )
+
+    def restore(self, snap: dict) -> None:
+        """Re-place a :meth:`snapshot` onto the mesh (works after the
+        device state was lost — ``_host_put`` reshards from host copies,
+        multi-process included).  The child-state cache is dropped: it
+        belonged to a level whose advance never happened."""
+        fs = self._frontier_spec
+        self.frontier = Frontier(
+            states=EvalState(
+                seed=self._host_put(snap["seed"], fs.states.seed),
+                bit=self._host_put(snap["bit"], fs.states.bit),
+                y_bit=self._host_put(snap["y_bit"], fs.states.y_bit),
+            ),
+            alive=self._host_put(snap["alive"], fs.alive),
+        )
+        self.alive_keys = self._host_put(snap["alive_keys"], P(SERVERS, DATA))
+        self._children = None
+
 
 class MeshLeader:
     """Level-loop driver over a MeshRunner (host-side thresholds/paths,
@@ -615,6 +657,32 @@ class MeshLeader:
             raise RuntimeError("count reconstruction out of range")
         return v.astype(np.uint32)
 
+    def _run_one_level(self, level: int, nreqs: int, threshold: float):
+        """One crawl->threshold->prune round; returns the kept counts for
+        this level, or None when the crawl died out (no survivors)."""
+        r = self.r
+        d = r.n_dims
+        counts = self._level_counts(level)
+        thresh = max(1, int(threshold * nreqs))
+        keep = counts >= thresh
+        keep[self.n_nodes :, :] = False
+        parent, pattern, n_alive = collect.compact_survivors(
+            keep, r.f_max, self.min_bucket
+        )
+        pat_bits = collect.pattern_to_bits(pattern, d)
+        self.obs.gauge("survivors", n_alive, level=level)
+        if n_alive == 0:
+            return None
+        if level < r.data_len - 1:  # nothing advances past the leaves
+            r.advance(level, parent, pat_bits, n_alive)
+        new_paths = np.zeros((n_alive, d, self.paths.shape[-1] + 1), bool)
+        for i in range(n_alive):
+            new_paths[i, :, :-1] = self.paths[parent[i]]
+            new_paths[i, :, -1] = pat_bits[i]
+        self.paths = new_paths
+        self.n_nodes = n_alive
+        return counts[parent[:n_alive], pattern[:n_alive]]
+
     def run(self, nreqs: int, threshold: float):
         from ..protocol.driver import CrawlResult
 
@@ -626,29 +694,109 @@ class MeshLeader:
         counts_kept = np.zeros(0, np.uint32)
         for level in range(r.data_len):
             with self.obs.span("level", level=level):
-                counts = self._level_counts(level)
-                thresh = max(1, int(threshold * nreqs))
-                keep = counts >= thresh
-                keep[self.n_nodes :, :] = False
-                parent, pattern, n_alive = collect.compact_survivors(
-                    keep, r.f_max, self.min_bucket
+                counts_kept = self._run_one_level(level, nreqs, threshold)
+            if counts_kept is None:
+                return CrawlResult(
+                    paths=np.zeros((0, d, level + 1), bool),
+                    counts=np.zeros(0, np.uint32),
                 )
-                pat_bits = collect.pattern_to_bits(pattern, d)
-                self.obs.gauge("survivors", n_alive, level=level)
-                if n_alive == 0:
+        return CrawlResult(paths=self.paths, counts=counts_kept)
+
+    def run_supervised(
+        self,
+        nreqs: int,
+        threshold: float,
+        *,
+        checkpoint_every: int = 2,
+        max_recoveries: int = 4,
+        chaos=None,
+    ):
+        """Fault-tolerant twin of :meth:`run` for the ICI path: host-side
+        snapshots of the device-resident frontier every
+        ``checkpoint_every`` levels, and recovery matched to what a mesh
+        fault actually costs:
+
+        - device state INTACT (a dropped data-parallel shard — the
+          collective's result can't be trusted but the frontier can):
+          re-run just that level;
+        - device state LOST (a participant killed mid-collective): restore
+          the last snapshot and re-run the lost levels — or restart from
+          scratch if none was taken yet.
+
+        ``chaos`` is a :class:`resilience.chaos.MeshChaos` injector (or
+        None); its ``before_level`` hook fires the scheduled faults.
+        Recovery is exact: counts are deterministic re-runs (secure-mode
+        share randomness differs, their reconstruction does not), so a
+        recovered crawl is bit-identical to a fault-free one."""
+        from ..protocol.driver import CrawlResult
+        from ..resilience.chaos import MeshFaultError
+        from .. import obs as obsmod
+
+        r = self.r
+        d = r.n_dims
+        r.tree_init()
+        self.paths = np.zeros((1, d, 0), bool)
+        self.n_nodes = 1
+        counts_kept = np.zeros(0, np.uint32)
+        # zero-touch the recovery counters: a supervised FAULT-FREE run
+        # must still carry the run report's recovery section (as zeros)
+        # so its absence can't be mistaken for a fault-free recovery
+        for c in ("recoveries", "levels_rerun", "shards_rerun"):
+            self.obs.count(c, 0)
+        stash = None  # (level, snapshot, paths, n_nodes, counts_kept)
+        recoveries = 0
+        level = 0
+        while level < r.data_len:
+            try:
+                if chaos is not None:
+                    chaos.before_level(r, level)
+                with self.obs.span("level", level=level):
+                    counts_kept = self._run_one_level(level, nreqs, threshold)
+                if counts_kept is None:
                     return CrawlResult(
                         paths=np.zeros((0, d, level + 1), bool),
                         counts=np.zeros(0, np.uint32),
                     )
-                if level < r.data_len - 1:  # nothing advances past the leaves
-                    r.advance(level, parent, pat_bits, n_alive)
-                new_paths = np.zeros(
-                    (n_alive, d, self.paths.shape[-1] + 1), bool
+                if level < r.data_len - 1 and (level + 1) % checkpoint_every == 0:
+                    stash = (
+                        level,
+                        r.snapshot(),
+                        self.paths.copy(),
+                        self.n_nodes,
+                        counts_kept.copy(),
+                    )
+                    self.obs.count("crawl_checkpoints", level=level)
+                level += 1
+            except MeshFaultError as err:
+                recoveries += 1
+                self.obs.count("recoveries")
+                obsmod.emit(
+                    "resilience.mesh_recover",
+                    severity="warn",
+                    level=level,
+                    attempt=recoveries,
+                    state_lost=err.state_lost,
+                    error=str(err),
                 )
-                for i in range(n_alive):
-                    new_paths[i, :, :-1] = self.paths[parent[i]]
-                    new_paths[i, :, -1] = pat_bits[i]
-                self.paths = new_paths
-                self.n_nodes = n_alive
-                counts_kept = counts[parent[:n_alive], pattern[:n_alive]]
+                if recoveries > max_recoveries:
+                    raise
+                if not err.state_lost and r.frontier is not None:
+                    # shard-granular cost: device state survived, only
+                    # this level's collective result is suspect
+                    self.obs.count("shards_rerun", level=level)
+                    continue
+                self.obs.count("levels_rerun")
+                if stash is not None:
+                    lvl, snap, paths, n_nodes, kept = stash
+                    r.restore(snap)
+                    self.paths = paths.copy()
+                    self.n_nodes = n_nodes
+                    counts_kept = kept.copy()
+                    level = lvl + 1
+                else:  # no snapshot yet: restart the crawl from scratch
+                    r.tree_init()
+                    self.paths = np.zeros((1, d, 0), bool)
+                    self.n_nodes = 1
+                    counts_kept = np.zeros(0, np.uint32)
+                    level = 0
         return CrawlResult(paths=self.paths, counts=counts_kept)

@@ -577,6 +577,66 @@ def _advance_cw_planar_jit(cw, frontier, parent_idx, pattern_bits, n_alive,
 
 
 # ---------------------------------------------------------------------------
+# Mid-level sharding (data-plane fault tolerance)
+#
+# A level's crawl can be split into deterministic spans over the frontier
+# NODE axis: each span is its own RPC verb with its own request id, so a
+# mid-level fault re-runs only the lost spans (protocol/leader_rpc.py's
+# shard retry) instead of the whole level.  Spans must be identical on
+# the leader and both servers — they are pure functions of (f_bucket,
+# shard_nodes), both public.
+# ---------------------------------------------------------------------------
+
+
+def shard_spans(f_bucket: int, shard_nodes: int) -> list:
+    """Deterministic node-axis spans ``[(lo, hi), ...]`` covering
+    ``[0, f_bucket)``.  ``shard_nodes <= 0`` (the default) disables
+    sharding: one span, the whole bucket."""
+    if shard_nodes <= 0 or f_bucket <= shard_nodes:
+        return [(0, f_bucket)]
+    return [
+        (lo, min(lo + shard_nodes, f_bucket))
+        for lo in range(0, f_bucket, shard_nodes)
+    ]
+
+
+def frontier_slice(
+    frontier: Frontier, lo: int, hi: int, planar: bool | None = None
+) -> Frontier:
+    """One shard's view of the frontier: node slots ``[lo, hi)`` of the
+    states and the alive mask, layout-aware (the node axis sits at
+    position 3/2 in the plane-major layout, 0 in the interleaved one)."""
+    if planar is None:
+        planar = _expand_engine()
+    st = frontier.states
+    if planar:
+        states = EvalState(
+            seed=st.seed[:, :, :, lo:hi],
+            bit=st.bit[:, :, lo:hi],
+            y_bit=st.y_bit[:, :, lo:hi],
+        )
+    else:
+        states = jax.tree.map(lambda a: a[lo:hi], st)
+    return Frontier(states=states, alive=frontier.alive[lo:hi])
+
+
+def children_cat(parts: list):
+    """Reassemble a full-level child-state cache from per-shard caches.
+
+    ``parts``: list of ``(lo, children)`` in any order; children are
+    whatever the engine's :func:`expand_share_bits` returned for each
+    shard (all the same type).  Concatenates along the node axis in
+    ``lo`` order — the exact inverse of :func:`frontier_slice`."""
+    parts = [c for _, c in sorted(parts, key=lambda t: t[0])]
+    if isinstance(parts[0], PlanarChildren):
+        return PlanarChildren(
+            seed=jnp.concatenate([p.seed for p in parts], axis=4),
+            flags=jnp.concatenate([p.flags for p in parts], axis=2),
+        )
+    return jax.tree.map(lambda *a: jnp.concatenate(a, axis=0), *parts)
+
+
+# ---------------------------------------------------------------------------
 # Host-side compaction helper (leader-side prune bookkeeping)
 # ---------------------------------------------------------------------------
 

@@ -18,6 +18,7 @@ from .. import obs as obsmod
 from ..obs import metrics as obsmetrics
 from ..ops.fields import F255, FE62
 from ..ops.ibdcf import IbDcfKeyBatch
+from ..resilience import policy as respolicy
 from ..utils.config import Config
 from . import collect
 from .driver import CrawlResult
@@ -42,6 +43,8 @@ class RpcLeader:
         self.paths: np.ndarray | None = None
         self.n_nodes = 0
         self.has_sketch = False
+        self._f_bucket = min_bucket  # current frontier bucket (shard plan)
+        self._boot_ids: dict = {}  # last known server boot ids
         # leader-side telemetry: level spans (the heartbeat names the
         # level a wedged crawl died in) + survivor gauges
         self.obs = obsmetrics.Registry("leader")
@@ -67,7 +70,11 @@ class RpcLeader:
         done, pending = await asyncio.wait(
             tasks, return_when=asyncio.FIRST_EXCEPTION
         )
-        failed = next((t for t in done if t.exception() is not None), None)
+        # retrieve EVERY done task's exception (not just the first): an
+        # unretrieved sibling failure would be dumped on the event loop
+        # as "Task exception was never retrieved" noise at GC time
+        errs = [(t, t.exception()) for t in done]
+        failed = next((t for t, e in errs if e is not None), None)
         if failed is not None:
             for t in pending:
                 t.cancel()
@@ -132,6 +139,68 @@ class RpcLeader:
             await self._all(*tasks)
         self.obs.count("keys_uploaded", n)
 
+    async def _crawl_level(self, level: int, last: bool):
+        """This level's crawl verbs, sharded when ``cfg.crawl_shard_nodes``
+        says so: one verb per deterministic node span
+        (``collect.shard_spans``), awaited span by span — the data plane
+        is positional, so both servers must work the same span at the
+        same time — each span under its own retry (:meth:`_shard_call`).
+        A mid-level fault costs the lost span(s), not the level."""
+        verb = "tree_crawl_last" if last else "tree_crawl"
+        # alternate the garbling server per level (the reference's
+        # gc_sender flip, leader.rs:204-210) to split garbling cost
+        req = {"level": level, "garbler": level % 2}
+        spans = collect.shard_spans(self._f_bucket, self.cfg.crawl_shard_nodes)
+        if len(spans) == 1:
+            return await self._both(verb, req)
+        parts0, parts1 = [], []
+        for span in spans:
+            s0, s1 = await self._shard_call(verb, dict(req, shard=list(span)))
+            # fhh-lint: disable=host-sync-in-hot-loop (wire responses:
+            # already host numpy off the control socket, no device sync)
+            parts0.append(np.asarray(s0))
+            # fhh-lint: disable=host-sync-in-hot-loop (wire response)
+            parts1.append(np.asarray(s1))
+        return np.concatenate(parts0, axis=0), np.concatenate(parts1, axis=0)
+
+    async def _shard_call(self, verb: str, req: dict):
+        """One shard's verbs on both servers, retried under the shared
+        policy: a transient mid-level fault re-keys the data plane
+        (``plane_reset`` — a half-executed secure shard leaves the two
+        servers' OT streams desynchronized, so the plane must re-handshake
+        before the span re-runs) and re-issues JUST this span.  A changed
+        boot id escalates unhandled — lost state is the supervisor's
+        problem, not a shard retry's."""
+        pol = respolicy.SHARD_POLICY
+        attempt = 0
+        while True:
+            try:
+                return await self._all(
+                    self.c0.call(verb, req), self.c1.call(verb, req)
+                )
+            except respolicy.TRANSIENT_ERRORS as err:
+                attempt += 1
+                if isinstance(err, ServerRestartedError) or attempt >= pol.attempts:
+                    raise
+                st0 = await self._probe(self.c0)
+                await self.c0.call("plane_reset")
+                st1 = await self._probe(self.c1)
+                for i, st in enumerate((st0, st1)):
+                    known = self._boot_ids.get(i)
+                    if known is not None and st["boot_id"] != known:
+                        raise  # restarted server: full recovery owns it
+                    self._boot_ids[i] = st["boot_id"]
+                self.obs.count("shards_rerun", level=int(req["level"]))
+                obsmod.emit(
+                    "resilience.shard_rerun",
+                    severity="warn",
+                    level=int(req["level"]),
+                    span=req.get("shard"),
+                    attempt=attempt,
+                    error=f"{type(err).__name__}: {err}",
+                )
+                await asyncio.sleep(pol.delay(attempt - 1))
+
     async def _run_one_level(self, level: int, nreqs: int, thresh: int):
         """One crawl->reconstruct->threshold->prune round under a level
         span (the heartbeat names this level while it runs).  Returns
@@ -153,12 +222,7 @@ class RpcLeader:
             # rpc.sketch_verify / sketch.py scope note).
             a0, _ = await self._both("sketch_verify", {"level": level})
             alive_after_verify = np.asarray(a0)
-        verb = "tree_crawl_last" if last else "tree_crawl"
-        # alternate the garbling server per level (the reference's
-        # gc_sender flip, leader.rs:204-210) to split garbling cost
-        s0, s1 = await self._both(
-            verb, {"level": level, "garbler": level % 2}
-        )
+        s0, s1 = await self._crawl_level(level, last)
         if last:
             v = np.asarray(F255.sub(s0, s1))  # leader-side reconstruct
             counts = v[..., 0].astype(np.uint32)  # counts < 2^32 by def
@@ -178,6 +242,7 @@ class RpcLeader:
         self.obs.gauge("survivors", n_alive, level=level)
         if n_alive == 0:
             return None, alive_after_verify
+        self._f_bucket = int(parent.shape[0])  # next level's shard plan
         if last:
             await self._both(
                 "tree_prune_last",
@@ -211,6 +276,7 @@ class RpcLeader:
         await self._both("tree_init", {"root_bucket": self.min_bucket})
         self.paths = np.zeros((1, d, 0), bool)
         self.n_nodes = 1
+        self._f_bucket = self.min_bucket
         thresh = max(1, int(cfg.threshold * nreqs))
         counts_kept = np.zeros(0, np.uint32)
         alive_before_leaf = None  # liveness after the latest verify
@@ -271,12 +337,29 @@ class RpcLeader:
         except ServerRestartedError:
             return await client.call("status")
 
-    async def _recover(self, keys0, keys1, stash) -> int:
+    async def _recover(self, keys0, keys1, sketch0, sketch1, stash) -> int:
         """Bring both servers back to one consistent state after any
         control-plane, data-plane, or server loss; returns the next level
         to run.  With a checkpoint stash: redial, re-establish the data
-        plane, re-seed restarted servers' keys, ``tree_restore`` both to
-        the stash level.  Without one: full restart from level 0."""
+        plane, re-seed restarted servers' keys (sketch material
+        included), ``tree_restore`` both to the stash level.  Without
+        one: full restart from level 0."""
+        if stash is None and sketch0 is not None:
+            # refuse BEFORE touching any server: restart-from-scratch
+            # would re-upload the SAME Beaver triple shares and commit a
+            # NEW ratchet root (fresh coin flip) — run 2's openings
+            # d' = <r', x> - a against run 1's d = <r, x> - a hand the
+            # servers <r - r', x> of every honest payload, the exact leak
+            # the ratchet prevents.  The supervisor banks an init (level
+            # -1) checkpoint precisely so this branch is unreachable with
+            # a working FHH_CKPT_DIR; without one, the only sound rerun
+            # uses fresh client sketch keys.
+            raise ValueError(
+                "sketch crawl cannot restart from scratch: re-opening "
+                "the same Beaver slabs under a fresh challenge root "
+                "would leak <r - r', x> — configure FHH_CKPT_DIR so "
+                "recovery can roll back, or rerun with fresh sketch keys"
+            )
         # probe s0 first: the supervisor's client redials under policy
         st0 = await self._probe(self.c0)
         # re-establish the data plane via the DIALER side, always: a
@@ -292,31 +375,34 @@ class RpcLeader:
             self._boot_ids[i] = st["boot_id"]
         if stash is None:
             # no checkpoint to stand on: restart the crawl from scratch
+            # (sketch mode was refused above — it can never restart)
             await self._both("reset")
-            await self.upload_keys(keys0, keys1)
+            await self.upload_keys(keys0, keys1, sketch0, sketch1)
             await self._both("tree_init", {"root_bucket": self.min_bucket})
             self.paths = np.zeros((1, self.cfg.n_dims, 0), bool)
             self.n_nodes = 1
+            self._f_bucket = self.min_bucket
             obsmod.emit(
                 "resilience.restarted_from_scratch",
                 severity="warn",
                 restarted_servers=restarted,
             )
             return 0
-        level, paths, n_nodes = stash[0], stash[1], stash[2]
+        level = stash["level"]
         for i in restarted:
             # a restarted server lost its key batch; re-seed it before
             # tree_restore re-concatenates (NO reset here: reset would
             # delete the very checkpoint files we are about to restore)
-            await self.upload_keys(keys0, keys1, which=i)
+            await self.upload_keys(keys0, keys1, sketch0, sketch1, which=i)
         r0, r1 = await self._both("tree_restore", {"level": level})
         if int(r0["level"]) != level or int(r1["level"]) != level:
             raise RuntimeError(
                 f"restored levels diverge: s0={r0['level']} s1={r1['level']} "
                 f"leader stash={level}"
             )
-        self.paths = paths.copy()
-        self.n_nodes = n_nodes
+        self.paths = stash["paths"].copy()
+        self.n_nodes = stash["n_nodes"]
+        self._f_bucket = stash["f_bucket"]
         obsmod.emit(
             "resilience.restored",
             level=level,
@@ -329,6 +415,8 @@ class RpcLeader:
         nreqs: int,
         keys0: IbDcfKeyBatch,
         keys1: IbDcfKeyBatch,
+        sketch0=None,
+        sketch1=None,
         *,
         checkpoint_every: int = 8,
         max_recoveries: int = 4,
@@ -345,42 +433,80 @@ class RpcLeader:
         Counts are exact re-runs: a recovered crawl's results are
         bit-identical to a fault-free one.
 
-        Malicious (sketch) mode is refused: the sketch challenge seed is
-        per-data-plane-session and stored pair shares open exactly once,
-        so a mid-crawl rollback would either replay a challenge or leak
-        (see ``rpc.sketch_verify``).  Checkpointing degrades gracefully:
-        servers without a checkpoint dir disable it (recovery then means
+        Malicious (sketch) mode is supervised too: pass the clients'
+        sketch key batches, and recovery re-seeds them alongside the
+        ibDCF keys.  The per-level challenge RATCHET (sketch.py) makes
+        the rollback sound — a re-run level derives the identical
+        challenge from the committed root + restored transcript digest,
+        so re-opening its Beaver slab is a bit-identical replay, never a
+        second opening.  Checkpointing degrades gracefully: servers
+        without a checkpoint dir disable it (recovery then means
         restart-from-scratch), keeping supervision usable everywhere."""
         cfg = self.cfg
         d, L = cfg.n_dims, cfg.data_len
-        if cfg.malicious or self.has_sketch:
+        if cfg.malicious and sketch0 is None:
             # refuse BEFORE touching the servers: proceeding would upload
             # keys without their sketch material and silently run a
             # malicious-mode collection semi-honest
             raise ValueError(
-                "run_supervised does not support malicious (sketch) mode"
+                "run_supervised in malicious mode needs the sketch key "
+                "batches (pass sketch0/sketch1)"
             )
         thresh = max(1, int(cfg.threshold * nreqs))
         await self._both("reset")
-        await self.upload_keys(keys0, keys1)
+        await self.upload_keys(keys0, keys1, sketch0, sketch1)
         await self._both("tree_init", {"root_bucket": self.min_bucket})
         self.paths = np.zeros((1, d, 0), bool)
         self.n_nodes = 1
+        self._f_bucket = self.min_bucket
         self._boot_ids = {
             0: self.c0.boot_id,
             1: self.c1.boot_id,
         }
-        stash = None  # (level, paths, n_nodes, counts_kept) at last ckpt
+        stash = None  # leader-side bookkeeping at the last checkpoint
         counts_kept = np.zeros(0, np.uint32)
+        alive_before_leaf = None  # liveness after the latest sketch verify
+        # zero-touch the recovery counters so a fault-free supervised run
+        # still reports a (zeroed) recovery section in the run report
+        for c in ("recoveries", "levels_rerun", "shards_rerun"):
+            self.obs.count(c, 0)
         ckpt_enabled = True
+        if sketch0 is not None:
+            # INIT checkpoint (level -1): sketch mode cannot restart from
+            # scratch (see _recover's refusal — same triples under a new
+            # root leak <r - r', x>), so bank a rollback point BEFORE any
+            # Beaver slab opens: a fault ahead of the first level
+            # checkpoint then restores the committed root + empty
+            # transcript and replays from level 0 under the identical
+            # challenge sequence.  Not counted as a crawl checkpoint —
+            # no crawl progress is banked by it.
+            try:
+                await self._both("tree_checkpoint", {"level": -1})
+                stash = {
+                    "level": -1,
+                    "paths": self.paths.copy(),
+                    "n_nodes": 1,
+                    "counts": counts_kept.copy(),
+                    "f_bucket": self._f_bucket,
+                    "alive": None,
+                }
+            except RuntimeError as e:
+                ckpt_enabled = False
+                obsmod.emit(
+                    "resilience.checkpoint_disabled",
+                    severity="warn",
+                    error=str(e),
+                )
         recoveries = 0
         level = 0
         while level < L:
             try:
                 with self.obs.span("level", level=level):
-                    counts_kept, _ = await self._run_one_level(
+                    counts_kept, alive = await self._run_one_level(
                         level, nreqs, thresh
                     )
+                if alive is not None:
+                    alive_before_leaf = alive
                 if counts_kept is None:
                     return CrawlResult(
                         paths=np.zeros((0, d, level + 1), bool),
@@ -393,12 +519,18 @@ class RpcLeader:
                 ):
                     try:
                         await self._both("tree_checkpoint", {"level": level})
-                        stash = (
-                            level,
-                            self.paths.copy(),
-                            self.n_nodes,
-                            counts_kept.copy(),
-                        )
+                        stash = {
+                            "level": level,
+                            "paths": self.paths.copy(),
+                            "n_nodes": self.n_nodes,
+                            "counts": counts_kept.copy(),
+                            "f_bucket": self._f_bucket,
+                            "alive": (
+                                None
+                                if alive_before_leaf is None
+                                else alive_before_leaf.copy()
+                            ),
+                        }
                         self.obs.count("crawl_checkpoints", level=level)
                     except RuntimeError as e:
                         # servers can't checkpoint (no FHH_CKPT_DIR):
@@ -424,16 +556,37 @@ class RpcLeader:
                     if recoveries > max_recoveries:
                         raise err
                     try:
-                        level = await self._recover(keys0, keys1, stash)
+                        level = await self._recover(
+                            keys0, keys1, sketch0, sketch1, stash
+                        )
                         break
                     except (ConnectionError, TimeoutError, RuntimeError) as e2:
                         err = e2  # recovery itself failed: another round
-                counts_kept = (
-                    stash[3].copy()
-                    if stash is not None
-                    else np.zeros(0, np.uint32)
-                )
+                if stash is not None:
+                    counts_kept = stash["counts"].copy()
+                    alive_before_leaf = (
+                        None if stash["alive"] is None
+                        else stash["alive"].copy()
+                    )
+                else:
+                    counts_kept = np.zeros(0, np.uint32)
+                    alive_before_leaf = None
                 self.obs.count("levels_rerun")
+        if self.has_sketch and L > 1:
+            # final F255 leaf-payload check, as in run() (read-only from
+            # the crawl's perspective: the verdict gates liveness flags)
+            a0, _ = await self._both("sketch_verify", {"level": L})
+            prev = (
+                alive_before_leaf
+                if alive_before_leaf is not None
+                else np.ones_like(np.asarray(a0))
+            )
+            if np.any(prev & ~np.asarray(a0)):
+                obsmod.emit(
+                    "sketch.leaf_forgery",
+                    severity="warn",
+                    new_exclusions=int(np.sum(prev & ~np.asarray(a0))),
+                )
         # final reconstruction, as in run() (final_shares is read-only:
         # the client's transparent replay covers transient losses here)
         f0, f1 = await self._both("final_shares")

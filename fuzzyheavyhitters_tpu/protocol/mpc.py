@@ -46,6 +46,16 @@ class TripleBatch(NamedTuple):
     c: jax.Array
 
 
+def level_slab(triples: TripleBatch, level: int) -> TripleBatch:
+    """One level's Beaver-triple slab out of a ``[..., L-1, CHECKS]``
+    batch.  The slab partition is the one-shot unit of the restartable
+    sketch (protocol/sketch.py ratchet): each level's checks consume
+    exactly its own slab, and a recovered level re-opens the SAME slab
+    under the SAME ratcheted challenge — a bit-identical replay, never a
+    second opening under fresh randomness."""
+    return TripleBatch(*[a[..., level, :] for a in triples])
+
+
 class MulStateBatch(NamedTuple):
     """One party's inputs to a batch of multiplication checks.
 

@@ -241,6 +241,13 @@ class CollectorServer:
     _sec_seed: np.ndarray | None = None  # session seed for GC/b2a randomness
     _crawl_ctr: int = 0  # makes per-crawl garbling randomness unique
     _last_shares: np.ndarray | None = None  # last-level leaf count shares
+    # mid-level sharding: per-shard child caches / leaf shares keyed by
+    # span lo, assembled at prune time (collect.children_cat); a shard
+    # re-run simply overwrites its slot
+    _shard_children: dict = field(default_factory=dict)
+    _shard_last: dict = field(default_factory=dict)
+    _shard_level: int | None = None
+    _mask_cache: tuple | None = None  # ((level, F, f255), full-level rows)
     _sketch_parts: list = field(default_factory=list)
     _sketch: object | None = None  # SketchKeyBatch (malicious-secure mode)
     _sketch_states: object | None = None  # DpfEvalState [F, N, d], frontier-following
@@ -248,7 +255,13 @@ class CollectorServer:
     _sketch_depth: int = 0  # how far the sketch frontier has advanced
     _sketch_pairs: tuple | None = None  # (pair shares [F, N, d, lanes], depth)
     _sketch_pairs_field: object | None = None
-    _sketch_seed: np.ndarray | None = None  # coin-flipped challenge seed
+    _sketch_seed: np.ndarray | None = None  # coin-flipped session seed
+    # challenge ratchet (sketch.py): the root seed committed at tree_init
+    # and the boot-independent transcript digest — together they derive
+    # each level's challenge, so a recovered level replays the IDENTICAL
+    # challenge instead of re-opening triples under fresh randomness
+    _sketch_root: np.ndarray | None = None
+    _ratchet_digest: bytes | None = None
     # telemetry: phase timers (the reference's 3-phase level taxonomy,
     # collect.rs:412-503, as "fss"/"gc_ot"/"field"), data-plane byte and
     # device-fetch accounting, gc_tests — all per level (obs/report.py
@@ -281,6 +294,9 @@ class CollectorServer:
         self.frontier = None
         self._children = None
         self._last_shares = None
+        self._shard_children.clear()
+        self._shard_last.clear()
+        self._shard_level = None
         self._sketch_parts.clear()
         self._sketch = None
         self._sketch_states = None
@@ -288,6 +304,8 @@ class CollectorServer:
         self._sketch_depth = 0
         self._sketch_pairs = None
         self._sketch_pairs_field = None
+        self._sketch_root = None
+        self._ratchet_digest = None
         self._ckpt_clear()  # a new collection must not resume an old one's
         self.obs.reset()  # fresh per-collection phase/byte/fetch accounting
         if self._ot is not None:  # fresh GC/b2a randomness per collection
@@ -330,13 +348,11 @@ class CollectorServer:
         self.alive_keys = np.ones(n, bool)
         self.frontier = collect.tree_init(self.keys, root_bucket)
         self._children = None
+        self._shard_children.clear()
+        self._shard_last.clear()
+        self._shard_level = None
         if self._sketch_parts:
-            leaves = [jax.tree.leaves(p) for p in self._sketch_parts]
-            cat = [np.concatenate([np.asarray(p[i]) for p in leaves])
-                   for i in range(len(leaves[0]))]
-            self._sketch = jax.tree.unflatten(
-                jax.tree.structure(_SKETCH_TREEDEF), cat
-            )
+            self._concat_sketch()
             root = dpf.eval_init(self._sketch.key)  # [N, d]
             self._sketch_states = jax.tree.map(
                 lambda a: jnp.broadcast_to(a[None], (1,) + a.shape), root
@@ -346,7 +362,37 @@ class CollectorServer:
             )
             self._sketch_depth = 0
             self._sketch_pairs = None
+            # commit the challenge ratchet: root = the coin flip of the
+            # CURRENT data-plane session (unpredictable to clients, who
+            # committed their keys before this point), transcript = empty.
+            # Both are checkpointed with the frontier, so later plane
+            # resets / restarts cannot perturb any level's challenge.
+            self._sketch_root = np.asarray(self._sketch_seed, np.uint32).copy()
+            self._ratchet_digest = sketchmod.transcript_init()
         return True
+
+    def _concat_sketch(self) -> None:
+        """Materialize ``self._sketch`` from the uploaded chunks (shared
+        by ``tree_init`` and the sketch ``tree_restore`` path — a restored
+        server re-receives its sketch chunks but must NOT re-root its
+        frontier-following states)."""
+        leaves = [jax.tree.leaves(p) for p in self._sketch_parts]
+        cat = [np.concatenate([np.asarray(p[i]) for p in leaves])
+               for i in range(len(leaves[0]))]
+        self._sketch = jax.tree.unflatten(
+            jax.tree.structure(_SKETCH_TREEDEF), cat
+        )
+
+    def _challenge_seed(self, level: int) -> np.ndarray:
+        """This level's sketch challenge via the ratchet (sketch.py):
+        hash(committed root ‖ level ‖ transcript digest).  Falls back to
+        the raw session seed only when the ratchet was never committed
+        (sketch keys without tree_init — a protocol error soon anyway)."""
+        if self._sketch_root is None:
+            return self._sketch_seed
+        return sketchmod.ratchet_seed(
+            self._sketch_root, level, self._ratchet_digest
+        )
 
     async def sketch_verify(self, req) -> np.ndarray:
         """Malicious-security check (ref intent: the TreeSketchFrontier*
@@ -364,9 +410,14 @@ class CollectorServer:
         check, and re-opening them under a second challenge would leak
         ``<r - r', x>`` (see protocol/sketch.py scope note).
 
-        The challenge randomness comes from the per-session coin-flipped
-        seed (``_plane_handshake``), never a public constant — a client
-        must not be able to predict r."""
+        The challenge randomness comes from the per-level RATCHET
+        (sketch.py): hash(coin-flipped root committed at ``tree_init`` ‖
+        level ‖ crawl-transcript digest) — never a public constant (a
+        client must not be able to predict r), and DETERMINISTIC given
+        the crawl transcript, so a level re-run after checkpoint recovery
+        replays the identical challenge instead of re-opening its Beaver
+        triple slab under fresh randomness (which would leak
+        ``<r - r', x>``)."""
         if self._sketch is None:
             raise RuntimeError("sketch_verify without sketch keys")
         level = int(req["level"])
@@ -420,6 +471,7 @@ class CollectorServer:
             fld = self._sketch_pairs_field
             last = fld is F255
             m_nodes, dpf_level = pairs_fn.shape[0], level - 1
+        challenge = self._challenge_seed(level)
         bs = max(
             1,
             self.cfg.sketch_batch_size_last if last else self.cfg.sketch_batch_size,
@@ -430,7 +482,7 @@ class CollectorServer:
             ks = jax.tree.map(lambda a: a[sl], self._sketch)
             n_sl = min(lo + bs, n) - lo
             r, rands = sketchmod.shared_r_stream(
-                fld, self._sketch_seed, level, m_nodes, n_sl * d
+                fld, challenge, level, m_nodes, n_sl * d
             )
             rands = rands.reshape((n_sl, d, 3) + fld.limb_shape)
             pairs = pairs_fn[:, sl]  # [F, n_sl, d, lanes(, limbs)]
@@ -439,7 +491,7 @@ class CollectorServer:
             if last:
                 trip, mk, mk2 = ks.triples_last, ks.mac_key_last, ks.mac_key2_last
             else:
-                trip = jax.tree.map(lambda a: a[..., dpf_level, :], ks.triples)
+                trip = mpc.level_slab(ks.triples, dpf_level)
                 mk, mk2 = ks.mac_key, ks.mac_key2
             mk = jnp.expand_dims(jnp.asarray(mk), 1)  # broadcast over dims
             mk2 = jnp.expand_dims(jnp.asarray(mk2), 1)
@@ -467,11 +519,13 @@ class CollectorServer:
         else:  # n == 0: nothing to verify
             ok = np.ones(n, bool)
         if level != 0:
-            # one-shot: each stored depth's triples open exactly once (a
-            # repeat would be a same-challenge replay at best — reject it
-            # outright rather than reason about it).  The level-0 path has
-            # no stored pairs and its re-run replays the identical
-            # level-tagged challenge, revealing nothing new.
+            # one-shot within a boot: each stored depth's pairs open once;
+            # a same-boot duplicate call is answered by the session dedup
+            # cache, and a post-recovery re-run reloads the pairs from the
+            # checkpoint and replays the IDENTICAL ratcheted challenge
+            # (same root, same transcript) — a replay, not a second
+            # opening.  The level-0 path has no stored pairs and re-runs
+            # under the same identical-challenge argument.
             self._sketch_pairs = None
         self.alive_keys &= ok
         return self.alive_keys.copy()
@@ -565,12 +619,40 @@ class CollectorServer:
             field_s=field.seconds,
         )
 
-    async def _crawl_counts(self, level: int, last: bool = False) -> np.ndarray:
+    def _shard_frontier(self, shard):
+        """The frontier view one crawl verb works on: the whole frontier
+        (``shard`` None) or the node span ``[lo, hi)`` of it.  Both
+        servers receive identical shard spans from the leader, so their
+        data-plane exchanges stay positionally matched."""
+        if shard is None:
+            return self.frontier
+        return collect.frontier_slice(self.frontier, shard[0], shard[1])
+
+    def _stash_children(self, level, shard, children) -> None:
+        """Bank one crawl's child-state cache for the coming prune: whole
+        level under ``_children``, shards keyed by span ``lo`` (a shard
+        RE-RUN overwrites its slot — exactly the retry semantics)."""
+        if shard is None:
+            self._children = children
+            return
+        if self._shard_level != int(level):
+            # first shard of a new level: drop any stale spans
+            self._shard_children.clear()
+            self._shard_last.clear()
+            self._shard_level = int(level)
+        self._children = None  # sharded levels assemble at prune time
+        if children is not None:
+            self._shard_children[int(shard[0])] = children
+
+    async def _crawl_counts(
+        self, level: int, last: bool = False, shard=None
+    ) -> np.ndarray:
         # per-level phase taxonomy of the reference (collect.rs:412-503);
         # trusted mode's "GC and OT" slot is the plaintext exchange
+        frontier = self._shard_frontier(shard)
         with self.obs.span("fss", level=level) as sp_fss:
-            packed, self._children = collect.expand_share_bits(
-                self.keys, self.frontier, level, want_children=not last
+            packed, children = collect.expand_share_bits(
+                self.keys, frontier, level, want_children=not last
             )
             # forces the device work to finish
             packed_np = await _fetch(packed, self.obs)
@@ -580,14 +662,16 @@ class CollectorServer:
         with self.obs.span("field", level=level) as sp_field:
             masks = collect.pattern_masks(self.keys.cw_seed.shape[1])
             counts = collect.counts_by_pattern(
-                packed, peer, masks, self.alive_keys, self.frontier.alive
+                packed, peer, masks, self.alive_keys, frontier.alive
             )
             counts = await _fetch(counts, self.obs)
         self._emit_level_phases(level, sp_fss, sp_gc, sp_field)
+        self._stash_children(level, shard, children)
         return counts
 
     async def _crawl_counts_secure(
-        self, level: int, count_field, last: bool = False, garbler: int = 0
+        self, level: int, count_field, last: bool = False, garbler: int = 0,
+        shard=None,
     ) -> np.ndarray:
         """The real 2PC data plane (ref: collect.rs:419-501): GC equality +
         OT b2a over the peer socket; returns this server's additive field
@@ -607,12 +691,13 @@ class CollectorServer:
         the garbled batch under the OUTPUT wire labels
         (secure.gb_step_fused).  (The reference runs GC then a separate
         OT round here, collect.rs:419-482.)"""
+        frontier = self._shard_frontier(shard)
         with self.obs.span("fss", level=level) as sp_fss:
             # dispatch time only: the FSS expansion itself overlaps the
             # exchange below (no sync — a block_until_ready here would
             # cost a tunnel RTT)
-            packed, self._children = collect.expand_share_bits(
-                self.keys, self.frontier, level, want_children=not last
+            packed, children = collect.expand_share_bits(
+                self.keys, frontier, level, want_children=not last
             )
             d = self.keys.cw_seed.shape[1]
             C, S = 1 << d, 2 * d
@@ -623,7 +708,7 @@ class CollectorServer:
             self.obs.gauge("ot_batch_size", B * S, level=level)
             flat = strs.reshape(B, S)
         with self.obs.span("gc_ot", level=level) as sp_gc:
-            w = secure.alive_weight(self.frontier.alive, self.alive_keys, C)
+            w = secure.alive_weight(frontier.alive, self.alive_keys, C)
             # crawl counter makes every garbling's randomness unique even
             # if a leader re-crawls a level without reset (seed reuse with
             # a fixed R = s would leak cross-run equality deltas to the
@@ -662,22 +747,51 @@ class CollectorServer:
             shares = secure.node_share_sums(count_field, vals, jnp.asarray(w))
             shares = await _fetch(shares, self.obs)
         self._emit_level_phases(level, sp_fss, sp_gc, sp_field)
+        self._stash_children(level, shard, children)
         return shares
 
+    @staticmethod
+    def _parse_shard(req):
+        s = (req or {}).get("shard")
+        return None if s is None else (int(s[0]), int(s[1]))
+
+    def _mask_rows(self, level: int, shard, C: int, f255: bool) -> np.ndarray:
+        """Wire-format mask rows for one (level, shard): the FULL-level
+        stream sliced to the shard's node rows — the leader's uniform
+        v0 - v1 reconstruction must be shard-oblivious, so a node's mask
+        cannot depend on how the level was sharded.  One-entry cache: the
+        S shard verbs of a level would otherwise each regenerate the
+        whole level's stream (the mask is a pure function of
+        (level, size), so staleness is impossible)."""
+        F = self.frontier.f_bucket
+        key = (level, F, f255)
+        if self._mask_cache is None or self._mask_cache[0] != key:
+            full = (
+                mask_f255(level, F * C).reshape(F, C, 8)
+                if f255
+                else mask_fe62(level, F * C).reshape(F, C)
+            )
+            self._mask_cache = (key, full)
+        full = self._mask_cache[1]
+        return full if shard is None else full[shard[0] : shard[1]]
+
     async def tree_crawl(self, req) -> np.ndarray:
-        """-> FE62 shares of per-child counts [F, 2^d] (ref: rpc.rs:60)."""
+        """-> FE62 shares of per-child counts [F, 2^d] (ref: rpc.rs:60).
+        An optional ``shard: (lo, hi)`` restricts the crawl to that node
+        span (mid-level retry granularity — the leader assembles)."""
         level = req["level"]
+        shard = self._parse_shard(req)
         if self.cfg.secure_exchange:
             return await self._crawl_counts_secure(
-                level, FE62, garbler=int(req.get("garbler", 0))
+                level, FE62, garbler=int(req.get("garbler", 0)), shard=shard
             )
-        counts = await self._crawl_counts(level)
+        counts = await self._crawl_counts(level, shard=shard)
         # NB: trusted mode — both servers hold these plaintext counts; the
         # shared-seed mask below is a WIRE-FORMAT shim so the leader's
         # uniform v0 - v1 reconstruction works, not a secrecy mechanism
         # (the reference's hardcoded bogus PRG seed plays the same role,
         # server.rs:331-332).  Secrecy comes from secure_exchange above.
-        r = mask_fe62(level, counts.size).reshape(counts.shape)
+        r = self._mask_rows(level, shard, counts.shape[-1], f255=False)
         if self.server_id == 0:
             # counts are already host-side; the mask add stays host-side
             # too (FE62.np_add) — the old device add + _fetch cost a full
@@ -688,15 +802,18 @@ class CollectorServer:
     async def tree_crawl_last(self, req) -> np.ndarray:
         """-> F255 shares [F, 2^d, 8] for the final level (ref: rpc.rs:61,
         collect.rs:775-916 — BlockPair double-block OT payloads in secure
-        mode).  Shares are retained for final_shares re-serving."""
+        mode).  Shares are retained for final_shares re-serving; sharded
+        calls bank their span and ``tree_prune_last`` assembles."""
         level = req["level"]
+        shard = self._parse_shard(req)
         if self.cfg.secure_exchange:
             shares = await self._crawl_counts_secure(
-                level, F255, last=True, garbler=int(req.get("garbler", 0))
+                level, F255, last=True, garbler=int(req.get("garbler", 0)),
+                shard=shard,
             )
         else:
-            counts = await self._crawl_counts(level, last=True)
-            r = mask_f255(level, counts.size).reshape(counts.shape + (8,))
+            counts = await self._crawl_counts(level, last=True, shard=shard)
+            r = self._mask_rows(level, shard, counts.shape[-1], f255=True)
             if self.server_id == 0:
                 c = np.zeros(counts.shape + (8,), np.uint32)
                 c[..., 0] = counts
@@ -704,7 +821,11 @@ class CollectorServer:
                 shares = F255.np_add(c, r)
             else:
                 shares = r
-        self._last_shares = shares
+        if shard is None:
+            self._last_shares = shares
+        else:
+            self._last_shares = None
+            self._shard_last[int(shard[0])] = shares
         return shares
 
     async def tree_prune(self, req) -> bool:
@@ -717,6 +838,8 @@ class CollectorServer:
         # fhh-lint: disable=host-sync-in-hot-loop (wire input: host numpy)
         pat_bits = np.asarray(req["pattern_bits"], bool)
         n_alive = int(req["n_alive"])
+        if self._children is None and self._shard_children:
+            self._children = self._assemble_shard_children()
         if self._children is not None:  # cache from this level's crawl
             self.frontier = collect.advance_from_children(
                 self._children, parent, pat_bits, n_alive
@@ -728,14 +851,45 @@ class CollectorServer:
             )
         if self._sketch is not None:
             self._advance_sketch(int(level), parent, pat_bits, n_alive)
+            self._ratchet_digest = sketchmod.transcript_absorb(
+                self._ratchet_digest, int(level), parent, pat_bits, n_alive
+            )
         self.obs.gauge("survivors", n_alive, level=int(level))
         return True
+
+    def _assemble_shard_children(self):
+        """Stitch the per-shard child caches back into one full-level
+        cache; refuses a torn level (a missing span would silently
+        advance garbage for its nodes)."""
+        children = collect.children_cat(sorted(self._shard_children.items()))
+        got = (
+            children.seed.shape[4]
+            if isinstance(children, collect.PlanarChildren)
+            else children.seed.shape[0]
+        )
+        if got != self.frontier.f_bucket:
+            raise RuntimeError(
+                f"sharded crawl incomplete: child caches cover {got} of "
+                f"{self.frontier.f_bucket} frontier slots"
+            )
+        self._shard_children.clear()
+        return children
 
     async def tree_prune_last(self, req) -> bool:
         """Last level keeps no child count states to advance — compact the
         stored leaf count shares down to the survivors
         (ref: collect.rs:931-942).  The sketch DPF does advance once more
         so its F255 leaf payloads can be verified post-prune."""
+        if self._last_shares is None and self._shard_last:
+            parts = sorted(self._shard_last.items())
+            whole = np.concatenate([p for _, p in parts], axis=0)
+            if whole.shape[0] != self.frontier.f_bucket:
+                raise RuntimeError(
+                    f"sharded last crawl incomplete: shares cover "
+                    f"{whole.shape[0]} of {self.frontier.f_bucket} slots"
+                )
+            self._last_shares = whole
+            self._shard_last.clear()
         if self._last_shares is None:  # protocol-boundary check: no assert
             raise RuntimeError("tree_prune_last called before tree_crawl_last")
         self._children = None  # leaf level: nothing advances past it
@@ -752,6 +906,9 @@ class CollectorServer:
             self._advance_sketch(
                 # fhh-lint: disable=host-sync-in-hot-loop (wire input)
                 L - 1, np.asarray(req["parent_idx"], np.int32), pattern, n_alive
+            )
+            self._ratchet_digest = sketchmod.transcript_absorb(
+                self._ratchet_digest, L - 1, parent, pattern, n_alive
             )
         self.obs.gauge(
             "survivors", n_alive, level=self.keys.cw_seed.shape[-2] - 1
@@ -778,7 +935,26 @@ class CollectorServer:
             "has_frontier": self.frontier is not None,
             "dedup_hits": int(self.obs.counter_value("dedup_hits")),
             "plane_resets": int(self.obs.counter_value("plane_resets")),
+            # numerically-ordered checkpoint levels on disk — the
+            # supervisor's "latest checkpoint" source of truth (string
+            # sorts would order l9 after l10 from level 10 on)
+            "ckpt_levels": self._ckpt_levels(),
         }
+
+    def _ckpt_levels(self) -> list:
+        """Level stamps of this server's on-disk checkpoints, ascending
+        NUMERICALLY (the same ordering :meth:`_ckpt_prune` keeps by)."""
+        if self.ckpt_dir is None or not os.path.isdir(self.ckpt_dir):
+            return []
+        prefix = f"fhh_server{self.server_id}_l"
+        levels = []
+        for name in os.listdir(self.ckpt_dir):
+            if name.startswith(prefix) and name.endswith(".npz"):
+                try:
+                    levels.append(int(name[len(prefix):-4]))
+                except ValueError:
+                    continue
+        return sorted(levels)
 
     def _ckpt_path(self, level: int) -> str:
         # level-stamped: a torn checkpoint round (one server wrote level k,
@@ -829,17 +1005,17 @@ class CollectorServer:
         under the other engine converts).  Keys are NOT in the blob (the
         leader re-uploads them on a restart — they are the bulk of the
         bytes and the leader already holds them).  Atomic tmp+rename so
-        a crash mid-write never corrupts the previous checkpoint."""
+        a crash mid-write never corrupts the previous checkpoint.
+
+        Malicious (sketch) mode checkpoints too: the blob carries the
+        frontier-following sketch DPF states, the stored (yet-unopened)
+        pair shares, the committed ratchet root, and the transcript
+        digest — everything a re-run needs to replay each level's
+        challenge bit-identically (see ``sketch.py``'s ratchet note)."""
         if self.ckpt_dir is None:
             raise RuntimeError(
                 "tree_checkpoint: no checkpoint dir configured "
                 "(start the server with FHH_CKPT_DIR set)"
-            )
-        if self._sketch is not None:
-            raise RuntimeError(
-                "malicious-secure crawls are not checkpointable: the "
-                "sketch challenge seed is per-data-plane-session and the "
-                "stored pair shares must open exactly once"
             )
         if self.frontier is None:
             raise RuntimeError("tree_checkpoint before tree_init")
@@ -848,18 +1024,34 @@ class CollectorServer:
         # ONE stacked fetch for the whole blob (device_get of the pytree),
         # not one sync per plane — through a remote-chip tunnel each fetch
         # is a full round trip
-        blob = jax.device_get(
-            {
-                "seed": st.seed,
-                "bit": st.bit,
-                "y_bit": st.y_bit,
-                "alive": self.frontier.alive,
-            }
-        )
+        fetch = {
+            "seed": st.seed,
+            "bit": st.bit,
+            "y_bit": st.y_bit,
+            "alive": self.frontier.alive,
+        }
+        if self._sketch is not None:
+            fetch["sk_state_seed"] = self._sketch_states.seed
+            fetch["sk_state_t"] = self._sketch_states.t
+            if self._sketch_pairs is not None:
+                fetch["sk_pairs"] = self._sketch_pairs[0]
+        blob = jax.device_get(fetch)
         blob["alive_keys"] = np.asarray(self.alive_keys)
         blob["level"] = np.int64(level)
         blob["planar"] = np.bool_(collect._expand_engine())
         blob["keys_fp"] = self._keys_fp()
+        if self._sketch is not None:
+            blob["sk_pids"] = np.asarray(self._sketch_pids)
+            blob["sk_depth"] = np.int64(self._sketch_depth)
+            blob["sk_root"] = np.asarray(self._sketch_root, np.uint32)
+            blob["sk_digest"] = np.frombuffer(
+                self._ratchet_digest, np.uint8
+            )
+            if self._sketch_pairs is not None:
+                blob["sk_pairs_depth"] = np.int64(self._sketch_pairs[1])
+                blob["sk_pairs_last"] = np.bool_(
+                    self._sketch_pairs_field is F255
+                )
         path = self._ckpt_path(level)
         tmp = f"{path}.tmp{os.getpid()}"
         with open(tmp, "wb") as f:
@@ -881,28 +1073,87 @@ class CollectorServer:
         ``level + 1``.  Requires keys: either still held (transient
         fault, same process) or re-uploaded via ``add_keys`` after a
         restart — and refuses a blob written under a different key
-        batch."""
+        batch.
+
+        Every validation runs BEFORE any state mutates: a mismatched
+        fingerprint, a truncated/corrupt npz, or a blob from a deeper
+        level than this key batch's tree must fail loudly and leave the
+        server's live state exactly as it was."""
         if self.ckpt_dir is None:
             raise RuntimeError("tree_restore: no checkpoint dir configured")
-        path = self._ckpt_path(int(req["level"]))
+        want_level = int(req["level"])
+        path = self._ckpt_path(want_level)
         if not os.path.exists(path):
             raise RuntimeError(f"tree_restore: no checkpoint at {path}")
-        if self._sketch is not None or self._sketch_parts:
-            raise RuntimeError(
-                "malicious-secure crawls are not restorable (see "
-                "tree_checkpoint)"
-            )
         if self.keys is None:
             if not self.keys_parts:
                 raise RuntimeError("tree_restore before add_keys")
             self._concat_keys()
-        with np.load(path) as npz:
-            z = {k: npz[k] for k in npz.files}
+        try:
+            with np.load(path) as npz:
+                z = {k: npz[k] for k in npz.files}
+        # np.load surfaces torn/partial writes as BadZipFile/ValueError/
+        # EOFError depending on where the file was cut; all of them mean
+        # the same thing at this boundary
+        except Exception as e:  # fhh-lint: disable=broad-except (corrupt-blob classification: every load failure maps to the same loud refusal; state is untouched)
+            raise RuntimeError(
+                f"tree_restore: corrupt or truncated checkpoint at {path} "
+                f"({type(e).__name__}: {e})"
+            ) from e
+        required = {"seed", "bit", "y_bit", "alive", "alive_keys", "level",
+                    "planar", "keys_fp"}
+        missing = required - set(z)
+        if missing:
+            raise RuntimeError(
+                f"tree_restore: checkpoint at {path} is missing fields "
+                f"{sorted(missing)} (truncated write?)"
+            )
         if not np.array_equal(z["keys_fp"], self._keys_fp()):
             raise RuntimeError(
                 "tree_restore: checkpoint was written under a different "
                 "key batch — re-upload the original keys"
             )
+        level = int(z["level"])
+        L = self.keys.cw_seed.shape[-2]
+        if level != want_level:
+            raise RuntimeError(
+                f"tree_restore: checkpoint at {path} is stamped level "
+                f"{want_level} but records level {level} (renamed or "
+                "tampered file)"
+            )
+        if level >= L - 1:
+            raise RuntimeError(
+                f"tree_restore: checkpoint level {level} is deeper than "
+                f"this key batch's tree (data_len={L}) — wrong collection"
+            )
+        n = self.keys.cw_seed.shape[0]
+        alive_keys = np.asarray(z["alive_keys"])
+        if alive_keys.shape[0] != n:
+            raise RuntimeError(
+                "tree_restore: checkpoint client count != key batch"
+            )
+        has_sketch = bool(self._sketch_parts) or self._sketch is not None
+        if has_sketch != ("sk_root" in z):
+            raise RuntimeError(
+                "tree_restore: sketch material mismatch — the checkpoint "
+                + ("lacks" if has_sketch else "carries")
+                + " sketch state relative to the uploaded keys"
+            )
+        if has_sketch:
+            # the validate-before-mutate contract covers the sketch
+            # fields too: a blob with sk_root but a torn/tampered tail
+            # must refuse here, not KeyError after the frontier mutated
+            sk_req = {"sk_state_seed", "sk_state_t", "sk_pids", "sk_depth",
+                      "sk_digest"}
+            if "sk_pairs" in z:
+                sk_req |= {"sk_pairs_depth", "sk_pairs_last"}
+            sk_missing = sk_req - set(z)
+            if sk_missing:
+                raise RuntimeError(
+                    f"tree_restore: checkpoint at {path} is missing sketch "
+                    f"fields {sorted(sk_missing)} (truncated write?)"
+                )
+        # -- all checks passed: mutate ------------------------------------
         states = EvalState(
             seed=jax.device_put(z["seed"]),
             bit=jax.device_put(z["bit"]),
@@ -915,18 +1166,38 @@ class CollectorServer:
                 if saved_planar
                 else collect.to_planar(states)
             )
-        n = self.keys.cw_seed.shape[0]
-        self.alive_keys = np.asarray(z["alive_keys"])
-        if self.alive_keys.shape[0] != n:
-            raise RuntimeError(
-                "tree_restore: checkpoint client count != key batch"
-            )
+        self.alive_keys = alive_keys
         self.frontier = collect.Frontier(
             states=states, alive=jax.device_put(z["alive"])
         )
         self._children = None
         self._last_shares = None
-        level = int(z["level"])
+        self._shard_children.clear()
+        self._shard_last.clear()
+        self._shard_level = None
+        if has_sketch:
+            if self._sketch is None:
+                self._concat_sketch()
+            self._sketch_states = dpf.DpfEvalState(
+                seed=jax.device_put(z["sk_state_seed"]),
+                t=jax.device_put(z["sk_state_t"]),
+            )
+            self._sketch_pids = np.asarray(z["sk_pids"])
+            self._sketch_depth = int(z["sk_depth"])
+            self._sketch_root = np.asarray(z["sk_root"], np.uint32).copy()
+            self._ratchet_digest = np.asarray(
+                z["sk_digest"], np.uint8
+            ).tobytes()
+            if "sk_pairs" in z:
+                self._sketch_pairs = (
+                    jax.device_put(z["sk_pairs"]), int(z["sk_pairs_depth"])
+                )
+                self._sketch_pairs_field = (
+                    F255 if bool(z["sk_pairs_last"]) else FE62
+                )
+            else:
+                self._sketch_pairs = None
+                self._sketch_pairs_field = None
         self.obs.count("checkpoint_restores", level=level)
         obs.emit(
             "resilience.server_restore", server=self.server_id, level=level
@@ -1003,6 +1274,7 @@ class CollectorServer:
         verb still executing await the same execution.  Errors are
         responses too — a deterministic rejection must replay as the same
         rejection, not as a second execution attempt."""
+        self.obs.count("verb_requests")  # denominator of the dedup rate
         if sess is not None:
             sess.last_seen = time.monotonic()
             if req_id in sess.cache:

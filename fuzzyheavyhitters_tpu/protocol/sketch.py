@@ -60,6 +60,8 @@ Scope note, stated honestly:
 
 from __future__ import annotations
 
+import hashlib
+import struct
 from functools import partial
 from typing import NamedTuple
 
@@ -72,6 +74,71 @@ from ..ops.dpf import DpfKeyBatch
 from . import mpc
 
 LANES = 2  # payload lanes: (x, k·x)
+
+# ---------------------------------------------------------------------------
+# Challenge ratchet (restartable sketch crawls)
+#
+# The per-session coin-flipped seed made malicious crawls one-shot: a
+# data-plane reset mid-crawl re-flipped the coin, so a recovered level
+# would open its Beaver triples under a DIFFERENT challenge r' and leak
+# <r - r', x> of honest payloads.  The ratchet fixes the challenge per
+# (collection, level) instead: each level's seed is a hash of
+#
+#   - a ROOT seed committed once per collection (the coin flip at the
+#     first data-plane handshake, captured at tree_init — still
+#     unpredictable to clients, who committed their keys beforehand);
+#   - the LEVEL index;
+#   - a boot-independent TRANSCRIPT DIGEST absorbing every survivor
+#     table the leader has applied (both servers receive identical prune
+#     frames, so both derive identical digests — and a checkpoint
+#     restore rewinds the digest with the frontier).
+#
+# A re-run of a level after recovery therefore replays the IDENTICAL
+# challenge: re-opening the same triple slab reveals exactly the wire
+# messages the first run already revealed — a replay, not a second
+# opening.  A challenge can only change if the transcript changed, in
+# which case the old slab was never opened under it.
+# ---------------------------------------------------------------------------
+
+_RATCHET_TAG = b"fhh-sketch-ratchet/1"
+
+
+def transcript_init() -> bytes:
+    """Root of the boot-independent crawl transcript digest (absorb
+    survivor tables with :func:`transcript_absorb`)."""
+    return hashlib.sha256(_RATCHET_TAG).digest()
+
+
+def transcript_absorb(
+    digest: bytes, level: int, parent: np.ndarray, pat_bits: np.ndarray,
+    n_alive: int,
+) -> bytes:
+    """Fold one prune's survivor table into the transcript digest.  Only
+    the REAL entries are absorbed (the bucket padding varies with
+    min_bucket and must not perturb the challenge)."""
+    h = hashlib.sha256(digest)
+    h.update(struct.pack("<qq", int(level), int(n_alive)))
+    h.update(np.ascontiguousarray(
+        np.asarray(parent[:n_alive], np.int64)
+    ).tobytes())
+    h.update(np.ascontiguousarray(
+        np.asarray(pat_bits[:n_alive], bool)
+    ).tobytes())
+    return h.digest()
+
+
+def ratchet_seed(root_seed, level: int, digest: bytes) -> np.ndarray:
+    """The challenge seed for one level: uint32[4] =
+    SHA-256(tag ‖ root ‖ level ‖ transcript)[:16].  Deterministic in its
+    inputs — the restartability contract — and unpredictable to clients
+    as long as the coin-flipped root is."""
+    h = hashlib.sha256(_RATCHET_TAG)
+    h.update(np.ascontiguousarray(
+        np.asarray(root_seed, np.uint32)
+    ).tobytes())
+    h.update(struct.pack("<q", int(level)))
+    h.update(digest)
+    return np.frombuffer(h.digest()[:16], dtype="<u4").copy()
 
 
 class SketchKeyBatch(NamedTuple):
@@ -352,7 +419,7 @@ def verify_level(
                 trip = ks.triples_last
                 mk, mk2 = ks.mac_key_last, ks.mac_key2_last
             else:
-                trip = jax.tree.map(lambda a: a[..., level, :], ks.triples)
+                trip = mpc.level_slab(ks.triples, level)
                 mk, mk2 = ks.mac_key, ks.mac_key2
             if extra:  # broadcast per-client MACs over the dim axis
                 mk = jnp.expand_dims(jnp.asarray(mk), 1)

@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import asyncio
 import struct
+import time
 from dataclasses import dataclass, field
 
 from .. import obs
@@ -265,6 +266,132 @@ class _ConnState:
                 ent[1] -= 1
                 return f
         return None
+
+
+# ---------------------------------------------------------------------------
+# Mesh (ICI) chaos: in-process fault injection for parallel/mesh.py
+#
+# The mesh path has no sockets to proxy — the whole two-party exchange is
+# XLA collectives (ppermute/psum) inside compiled programs, so faults are
+# injected at the LEVEL boundaries the host-side driver crosses anyway
+# (MeshLeader.run_supervised consults the injector before each level's
+# collective dispatch).  Three surrogates for the real ICI failure modes:
+#
+# - ``drop``  — a dropped data-parallel shard: the level's collective
+#   result cannot be trusted; device state (the frontier) is intact, so
+#   recovery is "re-run the level" — the shard-granular cost.
+# - ``kill``  — a donor device killed mid-all-gather: the injector
+#   CLOBBERS the runner's device-resident frontier (the in-process
+#   equivalent of losing a participating chip's HBM), so recovery must
+#   restore from the last host checkpoint.
+# - ``delay`` — a slow participant: the level stalls ``ms`` milliseconds
+#   but completes; recovery must NOT trigger (tests the absence of
+#   spurious rollbacks).
+#
+# Grammar (``FHH_MESH_FAULTS``): ``mesh:<action>@level=<N>[,ms=M]``,
+# ';'-separated, consumed once each like the proxy's clauses.
+# ---------------------------------------------------------------------------
+
+_MESH_ACTIONS = ("drop", "kill", "delay")
+
+
+class MeshFaultError(RuntimeError):
+    """An injected (or detected) mesh-collective fault; ``state_lost``
+    tells the supervisor whether the device-resident frontier survived
+    (drop: re-run the level) or not (kill: restore a checkpoint)."""
+
+    def __init__(self, msg: str, state_lost: bool = False):
+        super().__init__(msg)
+        self.state_lost = state_lost
+
+
+@dataclass(frozen=True)
+class MeshFaultSpec:
+    action: str
+    at_level: int
+    ms: int = 200
+
+    def __post_init__(self):
+        if self.action not in _MESH_ACTIONS:
+            raise ValueError(f"unknown mesh chaos action {self.action!r}")
+        if self.at_level < 0:
+            raise ValueError("level= trigger must be >= 0")
+
+
+def parse_mesh_faults(spec: str) -> list:
+    """Parse an ``FHH_MESH_FAULTS`` spec (grammar above).  Blank specs
+    parse to no faults; malformed clauses raise ValueError loudly, same
+    contract as :func:`parse_faults`."""
+    out: list[MeshFaultSpec] = []
+    for clause in (spec or "").split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        try:
+            head, args = clause.split("@", 1)
+            link, action = head.split(":", 1)
+        except ValueError:
+            raise ValueError(
+                f"bad mesh chaos clause {clause!r} "
+                "(want mesh:action@level=N[,ms=M])"
+            ) from None
+        if link.strip() != "mesh":
+            raise ValueError(f"mesh chaos clause {clause!r} must target 'mesh'")
+        kw: dict = {}
+        for part in args.split(","):
+            k, _, v = part.partition("=")
+            k, v = k.strip(), v.strip()
+            if k == "level":
+                kw["at_level"] = int(v)
+            elif k == "ms":
+                kw["ms"] = int(v)
+            else:
+                raise ValueError(f"unknown mesh chaos arg {k!r} in {clause!r}")
+        if "at_level" not in kw:
+            raise ValueError(f"mesh chaos clause {clause!r} missing level=")
+        out.append(MeshFaultSpec(action=action.strip(), **kw))
+    return out
+
+
+class MeshChaos:
+    """Consumed-once mesh fault schedule.  ``before_level(runner, level)``
+    is the hook :class:`parallel.mesh.MeshLeader` calls at each level
+    entry; a clause whose ``at_level`` has been reached fires exactly
+    once (re-run levels do not re-trigger it — the recovery must be able
+    to make progress, exactly like the proxy's fired severs)."""
+
+    def __init__(self, faults: list | None = None):
+        self._armed: list[MeshFaultSpec] = list(faults or [])
+        self.fired: list[tuple[str, int]] = []  # (action, level)
+
+    def before_level(self, runner, level: int) -> None:
+        for f in list(self._armed):
+            if level < f.at_level:
+                continue
+            self._armed.remove(f)
+            self.fired.append((f.action, level))
+            obs.emit(
+                "resilience.mesh_chaos_fired",
+                severity="debug",
+                action=f.action,
+                level=level,
+            )
+            if f.action == "delay":
+                time.sleep(f.ms / 1000.0)
+                continue
+            if f.action == "kill":
+                # the donor's HBM is gone: clobber the device frontier so
+                # any recovery short of a checkpoint restore fails loudly
+                runner.frontier = None
+                runner._children = None
+                raise MeshFaultError(
+                    f"mesh participant killed mid-collective at level "
+                    f"{level}", state_lost=True,
+                )
+            raise MeshFaultError(
+                f"data-parallel shard dropped at level {level}",
+                state_lost=False,
+            )
 
 
 @dataclass

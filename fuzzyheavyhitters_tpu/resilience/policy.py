@@ -193,3 +193,10 @@ DIAL_POLICY = RetryPolicy(base_s=0.05, cap_s=2.0, factor=2.0, attempts=10)
 # dial that hasn't completed in 5 s is dead — fail it and let the policy
 # back off and redial
 DIAL_TIMEOUT_S = 5.0
+
+# mid-level shard retry (leader_rpc._shard_call): a transient data-plane
+# fault re-keys the plane and re-runs JUST the lost shard.  Few attempts
+# on purpose — each retry already rides the client's own redial/replay
+# machinery, and a span that fails three times is a server problem the
+# full recovery path (checkpoint rollback) owns
+SHARD_POLICY = RetryPolicy(base_s=0.05, cap_s=1.0, factor=2.0, attempts=3)

@@ -27,6 +27,10 @@ Extra TPU-native knobs (all defaulted so reference configs load unchanged):
   before the first threshold, and the server refuses depth-1 re-verifies
   afterward (Beaver-triple reuse under a fresh challenge would leak).
 - ``f_max``: padded-frontier capacity (static device shapes).
+- ``crawl_shard_nodes``: split each level's crawl into node-axis shards of
+  this many frontier slots, one RPC verb per shard — a mid-level fault
+  then re-runs only the lost shards (protocol/leader_rpc.py shard retry).
+  0 (default) keeps one verb per level.
 """
 
 from __future__ import annotations
@@ -54,6 +58,10 @@ class Config:
     secure_exchange: bool = False
     malicious: bool = False
     f_max: int = 1024  # padded-frontier capacity (static shapes on device)
+    # mid-level retry granularity: frontier-node span per crawl shard
+    # (each shard is its own RPC verb — a mid-level fault re-runs only
+    # the lost shards, protocol/leader_rpc.py).  0 disables sharding.
+    crawl_shard_nodes: int = 0
 
 
 def load_config(path: str) -> Config:

@@ -2,23 +2,27 @@
 # Chaos-proxy recovery suite with a machine-readable artifact.
 #
 # Usage: scripts/chaos.sh [artifact.json]
-#   - runs the full fault-injection/recovery test suite
-#     (tests/test_resilience.py) on the CPU backend, INCLUDING the
-#     slow-marked storm scenarios tier-1 skips
+#   - runs the full fault-injection/recovery surface on the CPU backend:
+#     the socket-path suite (tests/test_resilience.py — control/data
+#     plane chaos, sketch recovery via the challenge ratchet, sharded
+#     mid-level retry) AND the mesh/ICI suite (tests/test_mesh_chaos.py),
+#     INCLUDING the slow-marked multi-fault storm tier-1 skips
 #   - writes a JSON artifact ({passed, failed, duration_s, tests}) to $1
 #     (default: chaos_report.json); exits non-zero on any failure
 #
-# The fixed fault schedule lives in the tests themselves (deterministic
-# frame-ordinal triggers — see resilience/chaos.py for the FHH_FAULTS
-# grammar); this script is the standalone/CI entry point, the same suite
-# runs (minus slow) inside tier-1.
+# The fixed fault schedules live in the tests themselves (deterministic
+# frame-ordinal / level triggers — see resilience/chaos.py for the
+# FHH_FAULTS and FHH_MESH_FAULTS grammars); this script is the
+# standalone/CI entry point, the same suites run (minus slow) inside
+# tier-1.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 artifact="${1:-chaos_report.json}"
 report="$(mktemp)"
 
-JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -m "" -q \
+JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_resilience.py tests/test_mesh_chaos.py -m "" -q \
     -p no:cacheprovider --junitxml="$report"
 rc=$?
 

@@ -413,6 +413,9 @@ def test_unbounded_await_scoped_to_transport_modules():
         src, "fuzzyheavyhitters_tpu/resilience/fake.py", rule="unbounded-await"
     )  # resilience is transport scope too
     assert _lint(
+        src, "fuzzyheavyhitters_tpu/parallel/fake.py", rule="unbounded-await"
+    )  # ... and parallel (mesh transport awaits need deadlines too)
+    assert _lint(
         src, "fuzzyheavyhitters_tpu/ops/fake.py", rule="unbounded-await"
     ) == []
     assert _lint(src, "tests/test_x.py", rule="unbounded-await") == []

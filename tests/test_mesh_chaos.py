@@ -1,0 +1,201 @@
+"""ICI-path chaos tests: fault injection for the device-mesh crawl.
+
+The mesh has no sockets to sever — its whole two-party exchange is XLA
+collectives — so faults are injected at the level boundaries the host
+driver crosses (resilience.chaos.MeshChaos): a dropped data-parallel
+shard (device state intact → re-run one level), a participant killed
+mid-collective (device frontier clobbered → restore the last host
+snapshot), and a delayed participant (no recovery — the level just
+stalls).  The acceptance bar mirrors the socket path's: recovered runs
+are BIT-IDENTICAL to fault-free ones, with the recovery visible in the
+counters and the run report.
+
+Shapes mirror tests/test_mesh.py (L=6, d=2, n=32, 2×4 mesh) so the crawl
+kernel family compiles once across both files via the persistent cache.
+Everything is pinned to the virtual CPU mesh (conftest) — this suite
+must pass under ``JAX_PLATFORMS=cpu``.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from fuzzyheavyhitters_tpu.obs import report as obsreport
+from fuzzyheavyhitters_tpu.ops import ibdcf
+from fuzzyheavyhitters_tpu.parallel import mesh as meshmod
+from fuzzyheavyhitters_tpu.protocol import driver
+from fuzzyheavyhitters_tpu.resilience.chaos import (
+    MeshChaos,
+    MeshFaultError,
+    MeshFaultSpec,
+    parse_mesh_faults,
+)
+from fuzzyheavyhitters_tpu.utils import bits as bitutils
+
+
+# ---------------------------------------------------------------------------
+# grammar
+# ---------------------------------------------------------------------------
+
+
+def test_parse_mesh_faults_grammar():
+    faults = parse_mesh_faults(
+        "mesh:drop@level=3;mesh:kill@level=5;mesh:delay@level=1,ms=50"
+    )
+    assert [f.action for f in faults] == ["drop", "kill", "delay"]
+    assert faults[0].at_level == 3
+    assert faults[2].ms == 50
+    assert parse_mesh_faults("") == [] and parse_mesh_faults(None) == []
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "mesh:drop",  # no trigger
+        "mesh:drop@ms=5",  # missing level=
+        "mesh:explode@level=1",  # unknown action
+        "plane:drop@level=1",  # wrong link
+        "mesh:drop@level=-1",  # negative level
+        "garbage",
+    ],
+)
+def test_parse_mesh_faults_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_mesh_faults(bad)
+
+
+def test_mesh_chaos_clauses_fire_once():
+    """A fired clause must not re-trigger on the recovery re-run of the
+    same level (the injector's twin of the proxy's consumed severs)."""
+
+    class R:  # minimal runner stand-in
+        frontier = object()
+        _children = None
+
+    chaos = MeshChaos([MeshFaultSpec("drop", 2)])
+    chaos.before_level(R(), 0)  # below the trigger: nothing
+    with pytest.raises(MeshFaultError) as ei:
+        chaos.before_level(R(), 2)
+    assert not ei.value.state_lost
+    chaos.before_level(R(), 2)  # the re-run proceeds
+    assert chaos.fired == [("drop", 2)]
+
+
+# ---------------------------------------------------------------------------
+# e2e recovery on the mesh
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def client_batch():
+    rng = np.random.default_rng(7)
+    L, d, n = 6, 2, 32
+    centers = rng.integers(0, 1 << L, size=(3, d))
+    pts = centers[rng.integers(0, 3, size=n)] + rng.integers(-1, 2, size=(n, d))
+    pts = np.clip(pts, 0, (1 << L) - 1)
+    pts_bits = np.array(
+        [[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts]
+    )
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng, engine="np")
+    return k0, k1, L, d, n
+
+
+def _as_dict(res):
+    return {
+        tuple(int(v) for v in row): int(c)
+        for row, c in zip(res.decode_ints(), res.counts)
+    }
+
+
+@pytest.fixture(scope="module")
+def oracle(client_batch, cpu_devices):
+    k0, k1, L, d, n = client_batch
+    with jax.default_device(cpu_devices[0]):
+        s0, s1 = driver.make_servers(k0, k1)
+        lead = driver.Leader(s0, s1, n_dims=d, data_len=L, f_max=128)
+        return _as_dict(lead.run(nreqs=n, threshold=0.1))
+
+
+def test_mesh_drop_and_kill_recover_bit_identical(
+    client_batch, oracle, cpu_devices
+):
+    """THE mesh acceptance scenario: one crawl suffers BOTH a dropped
+    data-parallel shard (level re-run, device state intact) and a killed
+    participant (device frontier lost → snapshot restore), plus a delay
+    that must NOT trigger recovery — and still produces heavy hitters
+    bit-identical to the fault-free run and the colocated oracle, with
+    the recovery events visible in the run report."""
+    k0, k1, L, d, n = client_batch
+    assert oracle
+
+    m = meshmod.make_mesh(devices=cpu_devices)
+    runner = meshmod.MeshRunner(m, k0, k1, f_max=128)
+    lead = meshmod.MeshLeader(runner)
+    res_ff = lead.run_supervised(n, 0.1, checkpoint_every=2)  # fault-free
+
+    chaos = MeshChaos(
+        parse_mesh_faults(
+            "mesh:delay@level=1,ms=20;mesh:drop@level=2;mesh:kill@level=4"
+        )
+    )
+    runner2 = meshmod.MeshRunner(m, k0, k1, f_max=128)
+    lead2 = meshmod.MeshLeader(runner2)
+    res = lead2.run_supervised(n, 0.1, checkpoint_every=2, chaos=chaos)
+
+    assert _as_dict(res) == _as_dict(res_ff) == oracle
+    np.testing.assert_array_equal(res.paths, res_ff.paths)
+    np.testing.assert_array_equal(res.counts, res_ff.counts)
+
+    # the faults fired and were matched to the right recovery:
+    assert set(chaos.fired) == {("delay", 1), ("drop", 2), ("kill", 4)}
+    assert lead2.obs.counter_value("recoveries") == 2  # delay is NOT one
+    assert lead2.obs.counter_value("shards_rerun") == 1  # the drop
+    assert lead2.obs.counter_value("levels_rerun") == 1  # the kill
+
+    # ... and are distinguishable from a fault-free run in the report
+    rep = obsreport.run_report([lead2.obs])
+    assert rep["recovery"]["count"] == 2
+    assert rep["recovery"]["shards_rerun"] == 1
+    assert rep["recovery"]["levels_rerun"] == 1
+    rep_ff = obsreport.run_report([lead.obs])
+    assert rep_ff["recovery"]["count"] == 0
+
+
+def test_mesh_kill_before_first_checkpoint_restarts(client_batch, oracle, cpu_devices):
+    """A participant killed before any snapshot exists degrades to
+    restart-from-scratch — the crawl, not the run, is lost."""
+    k0, k1, L, d, n = client_batch
+    m = meshmod.make_mesh(devices=cpu_devices)
+    runner = meshmod.MeshRunner(m, k0, k1, f_max=128)
+    lead = meshmod.MeshLeader(runner)
+    chaos = MeshChaos(parse_mesh_faults("mesh:kill@level=1"))
+    res = lead.run_supervised(n, 0.1, checkpoint_every=4, chaos=chaos)
+    assert _as_dict(res) == oracle
+    assert lead.obs.counter_value("recoveries") == 1
+
+
+def test_mesh_secure_recovers_bit_identical(client_batch, oracle, cpu_devices):
+    """Secure (GC+OT over ppermute) mesh crawl under the same kill+drop
+    schedule: share randomness differs per re-run, but the RECONSTRUCTED
+    counts must be bit-identical to the trusted oracle."""
+    k0, k1, L, d, n = client_batch
+    m = meshmod.make_mesh(devices=cpu_devices)
+    runner = meshmod.MeshRunner(m, k0, k1, f_max=128, secure_exchange=True)
+    lead = meshmod.MeshLeader(runner)
+    chaos = MeshChaos(parse_mesh_faults("mesh:drop@level=1;mesh:kill@level=3"))
+    res = lead.run_supervised(n, 0.1, checkpoint_every=2, chaos=chaos)
+    assert _as_dict(res) == oracle
+    assert lead.obs.counter_value("recoveries") == 2
+
+
+def test_mesh_exhausted_recoveries_reraise(client_batch, cpu_devices):
+    """An unrecoverable mesh (every level faulted) must surface the
+    MeshFaultError after max_recoveries, not loop forever."""
+    k0, k1, L, d, n = client_batch
+    m = meshmod.make_mesh(devices=cpu_devices)
+    runner = meshmod.MeshRunner(m, k0, k1, f_max=128)
+    lead = meshmod.MeshLeader(runner)
+    chaos = MeshChaos([MeshFaultSpec("drop", 0) for _ in range(9)])
+    with pytest.raises(MeshFaultError):
+        lead.run_supervised(n, 0.1, max_recoveries=3, chaos=chaos)

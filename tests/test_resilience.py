@@ -424,7 +424,11 @@ def test_session_cache_is_byte_bounded():
     assert 1 in one.cache  # over-cap singleton survives
 
 
-def test_run_supervised_refuses_malicious_mode(rng):
+def test_run_supervised_malicious_requires_sketch_material(rng):
+    """Malicious mode IS supervisable now (the challenge ratchet), but
+    only with the sketch key batches along — without them the crawl
+    would silently run semi-honest, so the refusal comes before any
+    server is touched."""
     cfg = _cfg(BASE_PORT + 310, malicious=True)
     k0, k1 = _client_keys(rng, 5, 6)
 
@@ -471,10 +475,13 @@ def test_blackhole_exhausts_verb_budget_loudly():
 
 
 async def _crawl_with_chaos(cfg, k0, k1, nreqs, *, ckpt_dir, ctl0_proxy=None,
-                            assassin=None, checkpoint_every=2):
+                            assassin=None, checkpoint_every=2,
+                            sk0=None, sk1=None, budgets=None):
     """One supervised crawl with optional chaos: a proxy on the
     leader↔server0 control link and/or an assassin coroutine (given the
     live servers dict + leader) that kills/restarts servers mid-crawl.
+    ``sk0``/``sk1`` ride along for malicious (sketch) mode; ``budgets``
+    overrides the clients' per-verb wall-clock budgets.
     Returns (result, leader, (c0, c1), live-servers dict)."""
     host0, p0 = cfg.server0.rsplit(":", 1)
     host1, p1 = cfg.server1.rsplit(":", 1)
@@ -484,8 +491,8 @@ async def _crawl_with_chaos(cfg, k0, k1, nreqs, *, ckpt_dir, ctl0_proxy=None,
     dial0 = (host0, p0)
     if ctl0_proxy is not None:
         dial0 = (ctl0_proxy.listen_host, ctl0_proxy.listen_port)
-    c0 = await rpc.CollectorClient.connect(*dial0)
-    c1 = await rpc.CollectorClient.connect(host1, p1)
+    c0 = await rpc.CollectorClient.connect(*dial0, budgets=budgets)
+    c1 = await rpc.CollectorClient.connect(host1, p1, budgets=budgets)
     lead = RpcLeader(cfg, c0, c1)
     kill_task = (
         asyncio.create_task(assassin(live, lead))
@@ -493,7 +500,7 @@ async def _crawl_with_chaos(cfg, k0, k1, nreqs, *, ckpt_dir, ctl0_proxy=None,
         else None
     )
     res = await lead.run_supervised(
-        nreqs, k0, k1, checkpoint_every=checkpoint_every
+        nreqs, k0, k1, sk0, sk1, checkpoint_every=checkpoint_every
     )
     if kill_task is not None:
         await kill_task
@@ -621,6 +628,411 @@ def test_supervised_without_ckpt_dir_degrades_gracefully(rng, tmp_path):
     ).run(nreqs=n, threshold=cfg.threshold)
     assert _hitters(res) == _hitters(want_res)
     assert lead.obs.counter_value("crawl_checkpoints") == 0
+
+
+# ---------------------------------------------------------------------------
+# challenge ratchet: unit semantics + restartable sketch crawls
+# ---------------------------------------------------------------------------
+
+
+def test_ratchet_seed_deterministic_and_sensitive():
+    """The restartability contract: identical (root, level, transcript)
+    -> identical challenge; ANY divergence -> a different challenge.
+    Bucket padding must not perturb the transcript (min_bucket varies
+    between hosts but the crawl is the same crawl)."""
+    from fuzzyheavyhitters_tpu.protocol import sketch as sketchmod
+
+    root = np.arange(4, dtype=np.uint32)
+    d0 = sketchmod.transcript_init()
+    a = sketchmod.ratchet_seed(root, 3, d0)
+    assert a.dtype == np.uint32 and a.shape == (4,)
+    np.testing.assert_array_equal(a, sketchmod.ratchet_seed(root, 3, d0))
+    assert not np.array_equal(a, sketchmod.ratchet_seed(root, 4, d0))
+    assert not np.array_equal(
+        a, sketchmod.ratchet_seed(root ^ np.uint32(1), 3, d0)
+    )
+    parent = np.array([0, 0], np.int32)
+    bits = np.array([[True], [False]])
+    d1 = sketchmod.transcript_absorb(d0, 0, parent, bits, 1)
+    assert d1 != d0
+    assert not np.array_equal(a, sketchmod.ratchet_seed(root, 3, d1))
+    # only the REAL survivor entries are absorbed: padding is invisible
+    padded = sketchmod.transcript_absorb(
+        d0, 0, np.array([0, 99], np.int32),
+        np.array([[True], [True]]), 1,
+    )
+    assert padded == d1
+
+
+def test_e2e_sketch_recovery_bit_identical(rng, tmp_path):
+    """THE sketch acceptance scenario: a MALICIOUS-mode crawl whose
+    leader↔server0 control link is severed mid-crawl AND whose server 1
+    is killed and restarted at a checkpoint boundary completes
+    bit-identically to a fault-free malicious run — cheater exclusion
+    included (the ratchet replays each recovered level's challenge
+    exactly, so re-opened Beaver slabs reveal nothing new and honest
+    clients' liveness flags land identically), with the recovery
+    distinguishable in the run report."""
+    from fuzzyheavyhitters_tpu.obs import report as obsreport
+    from fuzzyheavyhitters_tpu.ops.fields import F255, FE62
+    from fuzzyheavyhitters_tpu.protocol import sketch as sketchmod
+
+    L, n = 5, 12
+    port = BASE_PORT + 340
+    pxport = port + 20
+    pts = np.array([[11]] * 8 + [[25], [2], [50], [60]])
+    pts_bits = np.array(
+        [[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts]
+    )
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 1, rng, engine="np")
+    seeds = rng.integers(0, 2**32, size=(n, 2, 4), dtype=np.uint32)
+    cseed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    sk0, sk1 = sketchmod.gen(seeds, pts_bits[:, 0, :], FE62, F255, cseed)
+    # client 3 forges its level-2 payload (handed identically to both):
+    # its exclusion must SURVIVE the recovery re-runs
+    bad = np.asarray(sk0.key.cw_val).copy()
+    bad[3, 0, 2, 0] = (int(bad[3, 0, 2, 0]) + 1) % FE62.P
+    import jax.numpy as jnp
+
+    j = jnp.asarray(bad)
+    sk0 = sk0._replace(key=sk0.key._replace(cw_val=j))
+    sk1 = sk1._replace(key=sk1.key._replace(cw_val=j))
+    cfg = _cfg(
+        port, malicious=True, threshold=0.5, addkey_batch_size=12
+    )
+    ck, ck_ff = tmp_path / "ckpt", tmp_path / "ckpt_ff"
+    ck.mkdir(), ck_ff.mkdir()
+
+    async def faulty():
+        px = await ChaosProxy(
+            "127.0.0.1", pxport, "127.0.0.1", port,
+            parse_faults("ctl0:sever@msg=9,dir=s2c"), link="ctl0",
+        ).start()
+        res, lead, clients, live = await _crawl_with_chaos(
+            cfg, k0, k1, n, ckpt_dir=str(ck), ctl0_proxy=px,
+            assassin=_kill_and_restart_s1_at_first_checkpoint(cfg, port, ck),
+            sk0=sk0, sk1=sk1,
+        )
+        alive = live["s0"].alive_keys.copy()
+        rep = obsreport.run_report(
+            [lead.obs, live["s0"].obs, live["s1"].obs]
+        )
+        epochs = clients[0].epoch
+        await _teardown(clients, live, px)
+        return res, lead, alive, rep, epochs
+
+    async def fault_free():
+        res, lead, clients, live = await _crawl_with_chaos(
+            cfg, k0, k1, n, ckpt_dir=str(ck_ff), sk0=sk0, sk1=sk1
+        )
+        alive = live["s0"].alive_keys.copy()
+        rep = obsreport.run_report(
+            [lead.obs, live["s0"].obs, live["s1"].obs]
+        )
+        await _teardown(clients, live)
+        return res, alive, rep
+
+    res_ff, alive_ff, rep_ff = asyncio.run(fault_free())
+    res, lead, alive, rep, epochs = asyncio.run(faulty())
+
+    # bit-identical results AND liveness: the cheater (client 3) stays
+    # excluded, every honest client stays alive, counts match exactly
+    want_alive = np.ones(n, bool)
+    want_alive[3] = False
+    np.testing.assert_array_equal(alive, want_alive)
+    np.testing.assert_array_equal(alive_ff, want_alive)
+    assert _hitters(res) == _hitters(res_ff) == {(10,): 7, (11,): 7, (12,): 7}
+    np.testing.assert_array_equal(res.paths, res_ff.paths)
+    np.testing.assert_array_equal(res.counts, res_ff.counts)
+
+    # the faults happened, were survived, and are visible in the report
+    assert epochs >= 2  # leader↔s0 reconnected across the sever
+    assert lead.obs.counter_value("recoveries") >= 1
+    assert rep["recovery"]["count"] >= 1
+    assert rep["recovery"]["levels_rerun"] >= 1
+    assert rep["recovery"]["dedup_hits"] >= 1
+    assert rep["recovery"]["dedup_hit_rate"] > 0
+    assert rep_ff["recovery"]["count"] == 0  # distinguishable
+
+
+def test_sketch_recover_refuses_scratch_restart(rng):
+    """Stash-less recovery in sketch mode must refuse BEFORE touching any
+    server: re-uploading the same Beaver triple shares under a freshly
+    coin-flipped ratchet root opens the same slabs under two challenges —
+    the <r - r', x> leak the ratchet exists to prevent."""
+    from types import SimpleNamespace
+
+    from fuzzyheavyhitters_tpu.ops.fields import F255, FE62
+    from fuzzyheavyhitters_tpu.protocol import sketch as sketchmod
+
+    cfg = _cfg(BASE_PORT + 520, malicious=True)
+    k0, k1 = _client_keys(rng, 5, 6)
+    seeds = rng.integers(0, 2**32, size=(6, 1, 2, 4), dtype=np.uint32)
+    cseed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    sk0, sk1 = sketchmod.gen(
+        seeds, rng.integers(0, 2, size=(6, 1, 5)).astype(bool),
+        FE62, F255, cseed,
+    )
+    lead = RpcLeader(cfg, SimpleNamespace(), SimpleNamespace())  # no dials
+
+    async def run():
+        await lead._recover(k0, k1, sk0, sk1, None)
+
+    with pytest.raises(ValueError, match="fresh sketch keys"):
+        asyncio.run(run())
+
+
+def test_sketch_early_fault_recovers_via_init_checkpoint(rng, tmp_path):
+    """A sketch-mode fault BEFORE any level checkpoint must roll back to
+    the init (level -1) checkpoint — committed root, empty transcript —
+    and replay from level 0 bit-identically, never restart from scratch.
+    checkpoint_every=5 at L=5 means the init checkpoint is the ONLY one,
+    so the restore path is deterministic."""
+    from fuzzyheavyhitters_tpu.ops.fields import F255, FE62
+    from fuzzyheavyhitters_tpu.protocol import sketch as sketchmod
+
+    L, n = 5, 12
+    port = BASE_PORT + 540
+    pts = np.array([[11]] * 8 + [[25], [2], [50], [60]])
+    pts_bits = np.array(
+        [[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts]
+    )
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 1, rng, engine="np")
+    seeds = rng.integers(0, 2**32, size=(n, 2, 4), dtype=np.uint32)
+    cseed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    sk0, sk1 = sketchmod.gen(seeds, pts_bits[:, 0, :], FE62, F255, cseed)
+    cfg = _cfg(port, malicious=True, threshold=0.5, addkey_batch_size=12)
+    ck = tmp_path / "ckpt"
+    ck.mkdir()
+
+    def kill_after_level0(cfg, port, ck):
+        async def assassin(live, lead):
+            # level 0 done (paths grew) but no level checkpoint exists:
+            # the only rollback point is the init (-1) blob
+            while lead.paths is None or lead.paths.shape[-1] < 1:
+                await asyncio.sleep(0)
+            await live["s1"].aclose()
+            await asyncio.sleep(0.3)
+            live["s1"] = rpc.CollectorServer(1, cfg, ckpt_dir=str(ck))
+            await live["s1"].start(
+                "127.0.0.1", port + 10, "127.0.0.1", port + 11
+            )
+
+        return assassin
+
+    async def run():
+        res, lead, clients, live = await _crawl_with_chaos(
+            cfg, k0, k1, n, ckpt_dir=str(ck), sk0=sk0, sk1=sk1,
+            checkpoint_every=5,
+            assassin=kill_after_level0(cfg, port, ck),
+        )
+        alive = live["s0"].alive_keys.copy()
+        await _teardown(clients, live)
+        return res, lead, alive
+
+    res, lead, alive = asyncio.run(run())
+    assert (ck / "fhh_server0_l-1.npz").exists()  # the init checkpoint
+    assert lead.obs.counter_value("recoveries") >= 1
+    # honest batch (8 clients at 11, nobody forged): all 8 count
+    assert _hitters(res) == {(10,): 8, (11,): 8, (12,): 8}
+    assert alive.all()  # nobody excluded by the replayed challenges
+
+
+# ---------------------------------------------------------------------------
+# sharded mid-level retry
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("secure", [False, True], ids=["trusted", "secure"])
+def test_sharded_crawl_matches_unsharded(rng, secure):
+    """crawl_shard_nodes splits every level into per-span verbs; with no
+    faults the assembled counts must be bit-identical to the one-verb
+    crawl (mask rows, children and leaf shares all reassemble exactly)."""
+    L, n = 5, 12
+    port = BASE_PORT + (400 if secure else 440)
+    k0, k1 = _client_keys(rng, L, n)
+
+    async def run(shard_nodes, port_base):
+        cfg = _cfg(
+            port_base, secure_exchange=secure, crawl_shard_nodes=shard_nodes
+        )
+        s0, s1 = await _start_servers(cfg, port_base)
+        c0 = await rpc.CollectorClient.connect("127.0.0.1", port_base)
+        c1 = await rpc.CollectorClient.connect("127.0.0.1", port_base + 10)
+        lead = RpcLeader(cfg, c0, c1)
+        await lead._both("reset")
+        await lead.upload_keys(k0, k1)
+        res = await lead.run(n)
+        await _teardown((c0, c1), {"s0": s0, "s1": s1})
+        return res
+
+    res_sharded = asyncio.run(run(1, port))
+    res_whole = asyncio.run(run(0, port + 30))
+    assert _hitters(res_sharded) == _hitters(res_whole) and _hitters(res_whole)
+    np.testing.assert_array_equal(res_sharded.counts, res_whole.counts)
+    np.testing.assert_array_equal(res_sharded.paths, res_whole.paths)
+
+
+def test_e2e_mid_level_shard_loss_bit_identical(rng, tmp_path):
+    """The mid-level acceptance scenario: one crawl-shard request is
+    black-holed mid-level (no FIN — the verb budget converts it into a
+    loud timeout), and the leader re-runs ONLY that shard (fresh data
+    plane, same span) instead of rolling the level back.  Results are
+    bit-identical to the fault-free run; the shard re-run is counted in
+    the run report."""
+    from fuzzyheavyhitters_tpu.obs import report as obsreport
+
+    L, n = 5, 12
+    port = BASE_PORT + 480
+    pxport = port + 20
+    k0, k1 = _client_keys(rng, L, n)
+    cfg = _cfg(port, crawl_shard_nodes=1)
+    ck, ck_ff = tmp_path / "ckpt", tmp_path / "ckpt_ff"
+    ck.mkdir(), ck_ff.mkdir()
+    # generous enough for a warm level, small enough to keep the test
+    # quick: level 0 (the compile) runs before the fault ordinal
+    budgets = respolicy.VerbBudgets(default_s=10.0, per_verb={})
+
+    async def faulty():
+        # c2s frame 9 is a level-1 shard request (hello, reset, 2x
+        # add_keys, tree_init, L0 crawl, L0 prune, then the level-1
+        # spans): drop exactly one — the leader must re-run that span
+        px = await ChaosProxy(
+            "127.0.0.1", pxport, "127.0.0.1", port,
+            parse_faults("ctl0:blackhole@msg=9,count=1"), link="ctl0",
+        ).start()
+        res, lead, clients, live = await _crawl_with_chaos(
+            cfg, k0, k1, n, ckpt_dir=str(ck), ctl0_proxy=px, budgets=budgets
+        )
+        rep = obsreport.run_report([lead.obs, live["s0"].obs, live["s1"].obs])
+        await _teardown(clients, live, px)
+        return res, lead, rep
+
+    async def fault_free():
+        res, lead, clients, live = await _crawl_with_chaos(
+            cfg, k0, k1, n, ckpt_dir=str(ck_ff), budgets=budgets
+        )
+        await _teardown(clients, live)
+        return res
+
+    res_ff = asyncio.run(fault_free())
+    res, lead, rep = asyncio.run(faulty())
+
+    want_res = driver.Leader(
+        *driver.make_servers(k0, k1), n_dims=1, data_len=L, f_max=cfg.f_max
+    ).run(nreqs=n, threshold=cfg.threshold)
+    assert _hitters(res) == _hitters(res_ff) == _hitters(want_res)
+    assert _hitters(res)
+    np.testing.assert_array_equal(res.counts, res_ff.counts)
+
+    # the shard — not the level, not the crawl — was the retry unit
+    assert lead.obs.counter_value("shards_rerun") >= 1
+    assert lead.obs.counter_value("levels_rerun") == 0
+    assert rep["recovery"]["shards_rerun"] >= 1
+    assert rep["recovery"]["levels_rerun"] == 0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint negative paths + prune ordering
+# ---------------------------------------------------------------------------
+
+
+def _server_with_ckpt(tmp_path, level=1, seed=7, port_off=500):
+    """A lone server with keys, a root frontier, and one checkpoint at
+    ``level`` (checkpoint/restore never touch the data plane, so no peer
+    or listener is needed)."""
+    s = rpc.CollectorServer(0, _cfg(BASE_PORT + port_off), ckpt_dir=str(tmp_path))
+    k0, _ = _client_keys(np.random.default_rng(seed), 5, 6)
+
+    async def go():
+        await s.add_keys({"keys": tuple(np.asarray(x) for x in k0)})
+        await s.tree_init({})
+        await s.tree_checkpoint({"level": level})
+
+    asyncio.run(go())
+    return s
+
+
+def test_tree_restore_rejects_mismatched_key_fingerprint(tmp_path):
+    """A checkpoint written under one key batch must refuse to restore
+    under another — and leave the refusing server's state untouched."""
+    _server_with_ckpt(tmp_path, seed=7)
+    other = rpc.CollectorServer(
+        0, _cfg(BASE_PORT + 502), ckpt_dir=str(tmp_path)
+    )
+    k_other, _ = _client_keys(np.random.default_rng(8), 5, 6)
+
+    async def go():
+        await other.add_keys({"keys": tuple(np.asarray(x) for x in k_other)})
+        with pytest.raises(RuntimeError, match="different key batch"):
+            await other.tree_restore({"level": 1})
+
+    asyncio.run(go())
+    assert other.frontier is None  # nothing mutated on the failed path
+
+
+def test_tree_restore_rejects_truncated_npz(tmp_path):
+    """A torn/partially-written blob (crash mid-write of a NON-atomic
+    copy, disk-full tail loss) must fail loudly as corruption and leave
+    the live frontier exactly as it was."""
+    s = _server_with_ckpt(tmp_path, port_off=504)
+    path = s._ckpt_path(1)
+    data = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(data[: len(data) // 2])
+    frontier_before = s.frontier
+    alive_before = s.alive_keys.copy()
+
+    async def go():
+        with pytest.raises(RuntimeError, match="corrupt or truncated"):
+            await s.tree_restore({"level": 1})
+
+    asyncio.run(go())
+    assert s.frontier is frontier_before
+    np.testing.assert_array_equal(s.alive_keys, alive_before)
+
+
+def test_tree_restore_rejects_deeper_level_than_tree(tmp_path):
+    """A blob stamped deeper than this key batch's tree (data_len=5 ->
+    deepest resumable level is 3) is a wrong-collection artifact, not a
+    resume point."""
+    s = _server_with_ckpt(tmp_path, level=7, port_off=506)
+
+    async def go():
+        with pytest.raises(RuntimeError, match="deeper than"):
+            await s.tree_restore({"level": 7})
+
+    asyncio.run(go())
+
+
+def test_tree_restore_rejects_renamed_level_stamp(tmp_path):
+    """The filename stamp and the blob's recorded level must agree — a
+    renamed (or mis-copied) checkpoint restores the WRONG level's
+    frontier otherwise."""
+    import os as _os
+
+    s = _server_with_ckpt(tmp_path, level=1, port_off=508)
+    _os.rename(s._ckpt_path(1), s._ckpt_path(3))
+
+    async def go():
+        with pytest.raises(RuntimeError, match="records level"):
+            await s.tree_restore({"level": 3})
+
+    asyncio.run(go())
+
+
+def test_ckpt_prune_and_latest_order_numerically(tmp_path):
+    """Regression for levels >= 10: the keep-2 prune and the ckpt_levels
+    listing must order level stamps NUMERICALLY — lexicographic ordering
+    would rank l9 above l10/l11 and prune the two newest checkpoints."""
+    s = rpc.CollectorServer(0, _cfg(BASE_PORT + 510), ckpt_dir=str(tmp_path))
+    for lvl in (2, 9, 10, 11):
+        (tmp_path / f"fhh_server0_l{lvl}.npz").write_bytes(b"x")
+    assert s._ckpt_levels() == [2, 9, 10, 11]
+    s._ckpt_prune(keep=2)
+    left = sorted(p.name for p in tmp_path.iterdir())
+    assert left == ["fhh_server0_l10.npz", "fhh_server0_l11.npz"]
+    assert s._ckpt_levels() == [10, 11]
 
 
 @pytest.mark.slow
