@@ -28,6 +28,7 @@ from .. import obs
 from ..ops import ibdcf
 from ..protocol.leader_rpc import RpcLeader
 from ..protocol.rpc import CollectorClient
+from ..utils import compile_cache
 from ..utils import config as configmod
 from ..workloads import OUTPUT_CSV, rides, sample_points, strings
 
@@ -69,6 +70,9 @@ async def amain() -> None:
     import contextlib
 
     cfg, _, nreqs = configmod.get_args("Leader", get_n_reqs=True)
+    # persistent XLA compile cache (FHH_COMPILE_CACHE): repeat runs skip
+    # the per-bucket program compiles entirely
+    compile_cache.enable()
     rng = np.random.default_rng()
 
     # backend knob, like bin/server.py: "cpu" pins every uncommitted array
@@ -120,6 +124,23 @@ async def _run(cfg, nreqs: int, rng) -> None:
     c1 = await CollectorClient.connect(h1, p1)
 
     lead = RpcLeader(cfg, c0, c1)
+    # per-f_bucket compile warmup (FHH_WARMUP=0 opts out): bucket
+    # recompiles run now — and land in the FHH_COMPILE_CACHE when set —
+    # instead of billing into the crawl itself.  Needs the key shapes on
+    # the servers, so it rides after the upload in both paths below.
+    warm = os.environ.get("FHH_WARMUP", "1") != "0"
+
+    async def _maybe_warm():
+        if not warm:
+            return
+        t_w = time.perf_counter()
+        info = await lead.warmup()
+        obs.emit(
+            "warmup.done",
+            seconds=round(time.perf_counter() - t_w, 2),
+            f_buckets=info["f_buckets"],
+        )
+
     # supervised crawl (FHH_SUPERVISE=0 opts out), malicious mode
     # included — the per-level challenge ratchet makes sketch crawls
     # restartable (see protocol/sketch.py): the leader checkpoints every
@@ -131,11 +152,13 @@ async def _run(cfg, nreqs: int, rng) -> None:
         res = await lead.run_supervised(
             nreqs, k0, k1, sk0, sk1,
             checkpoint_every=int(os.environ.get("FHH_CKPT_EVERY", "16")),
+            warmup=warm,
         )
     else:
         await asyncio.gather(c0.call("reset"), c1.call("reset"))
         await lead.upload_keys(k0, k1, sk0, sk1)
         obs.emit("addkeys.done", seconds=round(time.perf_counter() - t0, 2))
+        await _maybe_warm()
         t0 = time.perf_counter()
         res = await lead.run(nreqs)
     obs.emit("crawl.done", seconds=round(time.perf_counter() - t0, 2))

@@ -40,6 +40,7 @@ import numpy as np
 from .. import obs
 from ..ops import ibdcf
 from ..parallel import mesh as meshmod
+from ..utils import compile_cache
 from ..utils import config as configmod
 from ..workloads import OUTPUT_CSV, rides, sample_points
 
@@ -72,6 +73,9 @@ def main() -> None:
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
+    # persistent XLA compile cache (FHH_COMPILE_CACHE) — after the
+    # platform pin so the cache keys against the platform actually used
+    compile_cache.enable()
     if args.processes:
         meshmod.init_distributed(
             args.coordinator, args.processes, args.process_id

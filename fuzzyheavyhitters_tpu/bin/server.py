@@ -24,6 +24,7 @@ import os
 
 from .. import obs
 from ..protocol.rpc import CollectorServer
+from ..utils import compile_cache
 from ..utils import config as configmod
 
 
@@ -77,6 +78,10 @@ def main() -> None:
     cfg, server_id, _ = configmod.get_args("Server", get_server_id=True)
     if server_id not in (0, 1):
         raise SystemExit(f"server_id must be 0 or 1, got {server_id}")
+    # persistent XLA compile cache (FHH_COMPILE_CACHE): a restarted
+    # server re-reads its crawl programs instead of recompiling them —
+    # recovery cost stays network + restore, not compile churn
+    compile_cache.enable()
     # both servers + the leader inherit ONE $FHH_RUN_REPORT from the shared
     # environment; the leader keeps the bare path, each server claims a
     # .s<id> sibling so the last exiter can't clobber the others' reports

@@ -98,6 +98,9 @@ def run_report(registries=None) -> dict:
     rec = _recovery_summary(out)
     if rec is not None:
         doc["recovery"] = rec
+    pipe = _pipeline_summary(out)
+    if pipe is not None:
+        doc["pipeline"] = pipe
     if dropped:
         doc["dropped_registries"] = dropped
     return doc
@@ -134,6 +137,66 @@ def _recovery_summary(registries: dict) -> dict | None:
         "dedup_hit_rate": round(
             sums["dedup_hits"] / max(1, sums["verb_requests"]), 6
         ),
+    }
+
+
+def _pipeline_summary(registries: dict) -> dict | None:
+    """Cross-registry pipelined-crawl rollup (protocol/leader_rpc.py's
+    bounded-depth span pipeline): per level and overall, the configured
+    in-flight ``depth``, ``overlap_seconds`` (span busy-time the pipeline
+    hid behind the level's wall-clock), and ``stalls`` (head-of-line
+    reassembly waits while a later span had already finished), plus
+    ``faults`` whenever a mid-flight failure quiesced the pipeline into
+    the sequential fallback.  Present only when a pipelined crawl ran —
+    sequential (depth 1) runs never emit these metrics."""
+    depth_by, overlap_by, stall_by = {}, {}, {}
+    overlap_total = stalls_total = faults_total = 0
+    depth_last = None
+    seen = False
+    for snap in registries.values():
+        g = snap.get("gauges", {}).get("pipeline_depth")
+        if g is not None:
+            seen = True
+            depth_last = g.get("last")
+            depth_by.update(g.get("by_level", {}))
+        t = snap.get("phases", {}).get("pipeline_overlap")
+        if t is not None:
+            seen = True
+            overlap_total += t.get("seconds", 0.0)
+            for lvl, s in t.get("by_level", {}).items():
+                overlap_by[lvl] = overlap_by.get(lvl, 0.0) + s
+        for name, total, by in (
+            ("pipeline_stalls", "stalls", stall_by),
+            ("pipeline_faults", "faults", None),
+        ):
+            c = snap.get("counters", {}).get(name)
+            if c is None:
+                continue
+            seen = True
+            if total == "stalls":
+                stalls_total += c.get("total", 0)
+                for lvl, n in c.get("by_level", {}).items():
+                    by[lvl] = by.get(lvl, 0) + n
+            else:
+                faults_total += c.get("total", 0)
+    if not seen:
+        return None
+    levels = sorted(
+        set(depth_by) | set(overlap_by) | set(stall_by), key=lambda k: int(k)
+    )
+    return {
+        "depth": depth_last,
+        "overlap_seconds": round(overlap_total, 6),
+        "stalls": stalls_total,
+        "faults": faults_total,
+        "by_level": {
+            lvl: {
+                "depth": depth_by.get(lvl),
+                "overlap_seconds": round(overlap_by.get(lvl, 0.0), 6),
+                "stalls": stall_by.get(lvl, 0),
+            }
+            for lvl in levels
+        },
     }
 
 

@@ -10,6 +10,9 @@ the F255 last level, and final heavy-hitter reconstruction with the same
 from __future__ import annotations
 
 import asyncio
+import collections as _collections
+import contextlib
+import time
 
 import jax
 import numpy as np
@@ -139,13 +142,47 @@ class RpcLeader:
             await self._all(*tasks)
         self.obs.count("keys_uploaded", n)
 
+    async def warmup(self, f_buckets=None) -> dict:
+        """Ask both servers to pre-compile the per-``f_bucket`` crawl
+        programs (rpc.CollectorServer.warmup) so bucket recompiles land
+        BEFORE measured crawl time — with ``FHH_COMPILE_CACHE`` set the
+        compiles also persist across processes.  Default bucket plan:
+        powers of two from ``min_bucket`` up to ``cfg.f_max`` (the exact
+        ladder ``collect.bucket_for`` walks as the frontier grows).
+        Call after ``upload_keys`` (the servers need the key shapes);
+        any time before or during the crawl is safe — warmup touches no
+        protocol state."""
+        if f_buckets is None:
+            f_buckets, b = [], max(1, self.min_bucket)
+            while b <= self.cfg.f_max:
+                f_buckets.append(b)
+                b *= 2
+            if f_buckets and f_buckets[-1] != self.cfg.f_max:
+                # non-power-of-two f_max: bucket_for caps at f_max itself,
+                # so the largest (most expensive) shape is f_max, not the
+                # last doubled rung — warm it too
+                f_buckets.append(self.cfg.f_max)
+        with self.obs.span("warmup"):
+            r0, r1 = await self._both(
+                "warmup", {"f_buckets": [int(b) for b in f_buckets]}
+            )
+        return {"f_buckets": list(f_buckets), "s0": r0, "s1": r1}
+
     async def _crawl_level(self, level: int, last: bool):
         """This level's crawl verbs, sharded when ``cfg.crawl_shard_nodes``
         says so: one verb per deterministic node span
-        (``collect.shard_spans``), awaited span by span — the data plane
-        is positional, so both servers must work the same span at the
-        same time — each span under its own retry (:meth:`_shard_call`).
-        A mid-level fault costs the lost span(s), not the level."""
+        (``collect.shard_spans``) — the data plane is positional, so both
+        servers must work the same span at the same time.  With
+        ``cfg.crawl_pipeline_depth`` > 1 up to that many span verbs ride
+        in flight at once with in-order reassembly (the servers serialize
+        execution on their verb lock in frame-arrival order, so the
+        positional data plane stays matched while span k+1's device
+        expand overlaps span k's GC/OT network phase); any in-flight
+        transient fault quiesces the pipeline and falls back to the
+        sequential per-span retry below, so PR 4's recovery and ratchet
+        semantics are untouched.  Sequentially each span runs under its
+        own retry (:meth:`_shard_call`).  A mid-level fault costs the
+        lost span(s), not the level."""
         verb = "tree_crawl_last" if last else "tree_crawl"
         # alternate the garbling server per level (the reference's
         # gc_sender flip, leader.rs:204-210) to split garbling cost
@@ -153,15 +190,130 @@ class RpcLeader:
         spans = collect.shard_spans(self._f_bucket, self.cfg.crawl_shard_nodes)
         if len(spans) == 1:
             return await self._both(verb, req)
+        depth = max(1, int(getattr(self.cfg, "crawl_pipeline_depth", 1)))
+        rerun = False
+        if depth > 1 and len(spans) > 1:
+            try:
+                return await self._crawl_level_pipelined(
+                    verb, req, spans, min(depth, len(spans)), level
+                )
+            except respolicy.TRANSIENT_ERRORS as err:
+                if isinstance(err, ServerRestartedError):
+                    raise  # lost state: the supervisor owns full recovery
+                await self._quiesce_after_pipeline_fault(level, err)
+                rerun = True
         parts0, parts1 = [], []
         for span in spans:
             s0, s1 = await self._shard_call(verb, dict(req, shard=list(span)))
+            if rerun:  # fallback re-execution after a pipeline fault
+                self.obs.count("shards_rerun", level=level)
             # fhh-lint: disable=host-sync-in-hot-loop (wire responses:
             # already host numpy off the control socket, no device sync)
             parts0.append(np.asarray(s0))
             # fhh-lint: disable=host-sync-in-hot-loop (wire response)
             parts1.append(np.asarray(s1))
         return np.concatenate(parts0, axis=0), np.concatenate(parts1, axis=0)
+
+    async def _crawl_level_pipelined(
+        self, verb: str, req: dict, spans: list, depth: int, level: int
+    ):
+        """Bounded-depth software pipeline over the level's spans: a
+        sliding window of up to ``depth`` span verbs in flight, refilled
+        as the OLDEST completes (in-order reassembly — the concatenated
+        result is positional).  The per-span verbs hit both servers in
+        frame order, so server-side execution order matches the
+        sequential path exactly; only the leader-side await structure
+        changes, which is what lets span k+1's expand (dispatched by the
+        server on frame arrival, rpc.py ``_pre_expand``) run while span
+        k's exchange is on the wire.  Telemetry: ``pipeline_depth`` /
+        ``pipeline_overlap`` (sum of span busy-seconds beyond the level's
+        wall-clock) / ``pipeline_stalls`` (head-of-line waits while a
+        LATER span had already finished) per level."""
+
+        def launch(span):
+            async def one():
+                t0 = time.monotonic()
+                r = await self._all(
+                    self.c0.call(verb, dict(req, shard=list(span))),
+                    self.c1.call(verb, dict(req, shard=list(span))),
+                )
+                return r, time.monotonic() - t0
+
+            return asyncio.ensure_future(one())
+
+        t_level = time.monotonic()
+        it = iter(spans)
+        window: _collections.deque = _collections.deque()
+        for _ in range(depth):
+            span = next(it, None)
+            if span is not None:
+                window.append(launch(span))
+        parts0, parts1 = [], []
+        busy = 0.0
+        stalls = 0
+        try:
+            while window:
+                head = window.popleft()
+                if not head.done() and any(t.done() for t in window):
+                    stalls += 1
+                # fhh-lint: disable=unbounded-await (each span call is
+                # bounded by its own per-verb wall-clock budget)
+                (s0, s1), dt = await head
+                busy += dt
+                # fhh-lint: disable=host-sync-in-hot-loop (wire response)
+                parts0.append(np.asarray(s0))
+                # fhh-lint: disable=host-sync-in-hot-loop (wire response)
+                parts1.append(np.asarray(s1))
+                nxt = next(it, None)
+                if nxt is not None:
+                    window.append(launch(nxt))
+        except BaseException:
+            # quiesce step 1: no new spans, cancel the in-flight window
+            # (their replay loops die; whatever already executed
+            # server-side drains under the server's verb lock)
+            for t in window:
+                t.cancel()
+            for t in window:
+                with contextlib.suppress(Exception, asyncio.CancelledError):
+                    await t
+            raise
+        wall = time.monotonic() - t_level
+        self.obs.gauge("pipeline_depth", depth, level=level)
+        self.obs.timer_add(
+            "pipeline_overlap", max(0.0, busy - wall), level=level
+        )
+        if stalls:
+            self.obs.count("pipeline_stalls", stalls, level=level)
+        return np.concatenate(parts0, axis=0), np.concatenate(parts1, axis=0)
+
+    async def _quiesce_after_pipeline_fault(self, level: int, err) -> None:
+        """Quiesce step 2, after the in-flight window is cancelled: break
+        any data-plane exchange wedged by the fault (a span that reached
+        only ONE server leaves its peer blocked in a ``_swap`` recv
+        holding the verb lock — ``plane_break`` closes the peer transport
+        from BOTH ends without taking that lock, so the wedged verbs fail
+        loudly and release), then re-key the plane through the normal
+        locked ``plane_reset`` and verify neither server restarted.  The
+        caller then re-runs the whole level sequentially; the servers'
+        per-span caches overwrite, so the re-run is bit-identical."""
+        self.obs.count("pipeline_faults", level=level)
+        obsmod.emit(
+            "pipeline.quiesce",
+            severity="warn",
+            level=level,
+            error=f"{type(err).__name__}: {err}",
+        )
+        await self._all(
+            self.c0.call("plane_break"), self.c1.call("plane_break")
+        )
+        st0 = await self._probe(self.c0)
+        await self.c0.call("plane_reset")
+        st1 = await self._probe(self.c1)
+        for i, st in enumerate((st0, st1)):
+            known = self._boot_ids.get(i)
+            if known is not None and st["boot_id"] != known:
+                raise err  # restarted server: full recovery owns it
+            self._boot_ids[i] = st["boot_id"]
 
     async def _shard_call(self, verb: str, req: dict):
         """One shard's verbs on both servers, retried under the shared
@@ -420,6 +572,7 @@ class RpcLeader:
         *,
         checkpoint_every: int = 8,
         max_recoveries: int = 4,
+        warmup: bool = False,
     ) -> CrawlResult:
         """The fault-tolerant twin of :meth:`run`, owning the WHOLE crawl
         (reset + upload + levels + final reconstruction) because recovery
@@ -455,6 +608,11 @@ class RpcLeader:
         thresh = max(1, int(cfg.threshold * nreqs))
         await self._both("reset")
         await self.upload_keys(keys0, keys1, sketch0, sketch1)
+        if warmup:
+            # per-f_bucket compile warmup before any crawl time is spent;
+            # rides inside the supervised flow because reset() above
+            # cleared any earlier upload (warmup needs the key shapes)
+            await self.warmup()
         await self._both("tree_init", {"root_bucket": self.min_bucket})
         self.paths = np.zeros((1, d, 0), bool)
         self.n_nodes = 1

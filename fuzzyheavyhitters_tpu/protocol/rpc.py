@@ -89,14 +89,22 @@ _SKETCH_TREEDEF = sketchmod.SketchKeyBatch(
 )
 
 
-async def _send(writer: asyncio.StreamWriter, obj, count=None) -> None:
+async def _send(writer: asyncio.StreamWriter, obj, count=None,
+                flush: bool = True) -> None:
     """``count``, when given, is called with the framed byte size — the
-    data-plane accounting hook (obs counters)."""
+    data-plane accounting hook (obs counters).  ``flush=False`` skips the
+    ``drain()`` backpressure wait: asyncio delivers the buffered bytes
+    regardless (drain only waits when the write buffer tops the
+    high-water mark), so a burst of consecutive frames can coalesce into
+    ONE drain on its final frame instead of one await per frame — but
+    some frame in every burst MUST flush, or a dead peer lets the buffer
+    grow without bound."""
     data = pickle.dumps(obj, protocol=5)
     if count is not None:
         count(len(data) + _HDR.size)
     writer.write(_HDR.pack(len(data)) + data)
-    await writer.drain()
+    if flush:
+        await writer.drain()
 
 
 async def _recv(reader: asyncio.StreamReader, count=None):
@@ -129,7 +137,25 @@ async def _fetch(
     site sits outside any span (span-active callers inherit)."""
     if reg is not None:
         reg.count("device_fetches", level=level)
+    _start_host_copy(x)
     return await asyncio.to_thread(np.asarray, x)
+
+
+def _start_host_copy(x) -> None:
+    """Kick off the device->host DMA for ``x`` without blocking (the
+    ``copy_to_host_async`` half of a double-buffered fetch): the copy
+    proceeds while the caller does other work — another span's expand
+    dispatch, a peer exchange — and the later ``np.asarray`` completes
+    against an already-landed (or in-flight) buffer instead of starting
+    the transfer then.  Best-effort: plain numpy inputs and JAX builds
+    without the method fall through to the synchronous copy."""
+    fn = getattr(x, "copy_to_host_async", None)
+    if fn is None:
+        return
+    try:
+        fn()
+    except Exception:  # fhh-lint: disable=broad-except (pure prefetch hint: any failure means the sync np.asarray path simply does the whole copy)
+        pass
 
 
 def _mask_words(level: int, n: int, blocks_for: int) -> np.ndarray:
@@ -248,6 +274,13 @@ class CollectorServer:
     _shard_last: dict = field(default_factory=dict)
     _shard_level: int | None = None
     _mask_cache: tuple | None = None  # ((level, F, f255), full-level rows)
+    # pipelined-crawl expand stage: device work dispatched at FRAME
+    # ARRIVAL (before the verb lock) keyed by (kind, level, span), so
+    # span k+1's FSS expansion runs while span k's open stage is on the
+    # data plane.  Entries are pure functions of (keys, frontier, level,
+    # span) — reuse across a shard re-run is bit-identical — and every
+    # frontier mutation (prune/restore/init/reset) clears the dict.
+    _expand_ready: dict = field(default_factory=dict)
     _sketch_parts: list = field(default_factory=list)
     _sketch: object | None = None  # SketchKeyBatch (malicious-secure mode)
     _sketch_states: object | None = None  # DpfEvalState [F, N, d], frontier-following
@@ -297,6 +330,7 @@ class CollectorServer:
         self._shard_children.clear()
         self._shard_last.clear()
         self._shard_level = None
+        self._expand_ready.clear()
         self._sketch_parts.clear()
         self._sketch = None
         self._sketch_states = None
@@ -351,6 +385,7 @@ class CollectorServer:
         self._shard_children.clear()
         self._shard_last.clear()
         self._shard_level = None
+        self._expand_ready.clear()
         if self._sketch_parts:
             self._concat_sketch()
             root = dpf.eval_init(self._sketch.key)  # [N, d]
@@ -644,15 +679,86 @@ class CollectorServer:
         if children is not None:
             self._shard_children[int(shard[0])] = children
 
+    # -- expand stage (device) vs open stage (plane I/O) -----------------
+    #
+    # The per-span crawl is split so the DEVICE half can run ahead of the
+    # PLANE half: ``_do_expand`` dispatches the FSS expansion (+ string
+    # extraction in secure mode) and starts the host copy; the open stage
+    # consumes it under the verb lock.  ``_maybe_pre_expand`` runs the
+    # expand stage at FRAME ARRIVAL — while the previous span's open
+    # stage is awaiting the data plane — which is what overlaps span k's
+    # GC/OT network phase with span k+1's device compute (the leader
+    # keeps both frames in flight via ``crawl_pipeline_depth``).
+
+    def _do_expand(self, level: int, last: bool, shard) -> dict:
+        """Device half of one crawl span: dispatch-only (no sync — a
+        block_until_ready here would cost a tunnel RTT); pure function of
+        (keys, frontier, level, span), so a shard re-run may reuse it
+        bit-identically."""
+        frontier = self._shard_frontier(shard)
+        packed, children = collect.expand_share_bits(
+            self.keys, frontier, level, want_children=not last
+        )
+        out = {"packed": packed, "children": children, "frontier": frontier}
+        if self.cfg.secure_exchange:
+            d = self.keys.cw_seed.shape[1]
+            strs = secure.child_strings(packed, d)  # [F, C, N, S]
+            F_, C, N, S = strs.shape
+            out["flat"] = strs.reshape(F_ * C * N, S)
+            out["dims"] = (F_, C, N, S)
+        else:
+            # double-buffer: the D2H copy of the packed bits starts NOW
+            # and lands while other work (the previous span's exchange)
+            # holds the event loop
+            _start_host_copy(packed)
+        return out
+
+    def _expand_stage(self, level: int, last: bool, shard) -> dict:
+        hit = self._expand_ready.pop((bool(last), int(level), shard), None)
+        if hit is not None:
+            self.obs.count("pipeline_expand_hits", level=int(level))
+            return hit
+        return self._do_expand(level, last, shard)
+
+    def _maybe_pre_expand(self, verb: str, req) -> None:
+        """Frame-arrival hook (``_dispatch``, BEFORE the verb lock): run
+        the expand stage for a sharded crawl verb while earlier spans
+        still hold the lock.  Purely an overlap optimization — any
+        refusal or failure here just means the verb recomputes (and
+        surfaces the real error) under the lock."""
+        if verb not in ("tree_crawl", "tree_crawl_last"):
+            return
+        shard = self._parse_shard(req)
+        if shard is None or self.keys is None or self.frontier is None:
+            return
+        if shard[1] > self.frontier.f_bucket:
+            return  # span from another life (stale replay): let it fail
+        level, last = int(req["level"]), verb == "tree_crawl_last"
+        key = (last, level, shard)
+        # bound the stash: depth-many entries live at a time in practice;
+        # 32 is far above any sane pipeline depth
+        if key in self._expand_ready or len(self._expand_ready) >= 32:
+            return
+        try:
+            t0 = time.monotonic()
+            self._expand_ready[key] = self._do_expand(level, last, shard)
+            # dispatch time only, attributed to the fss phase the verb
+            # would otherwise have spent it in (no span: another verb's
+            # span may be active on this registry right now)
+            self.obs.timer_add("fss", time.monotonic() - t0, level=level)
+            self.obs.count("pipeline_pre_expands", level=level)
+        except Exception:  # fhh-lint: disable=broad-except (prefetch only: the verb recomputes under the lock and surfaces the real error to the leader)
+            self._expand_ready.pop(key, None)
+
     async def _crawl_counts(
         self, level: int, last: bool = False, shard=None
     ) -> np.ndarray:
         # per-level phase taxonomy of the reference (collect.rs:412-503);
         # trusted mode's "GC and OT" slot is the plaintext exchange
-        frontier = self._shard_frontier(shard)
         with self.obs.span("fss", level=level) as sp_fss:
-            packed, children = collect.expand_share_bits(
-                self.keys, frontier, level, want_children=not last
+            ex = self._expand_stage(level, last, shard)
+            packed, children, frontier = (
+                ex["packed"], ex["children"], ex["frontier"]
             )
             # forces the device work to finish
             packed_np = await _fetch(packed, self.obs)
@@ -691,22 +797,19 @@ class CollectorServer:
         the garbled batch under the OUTPUT wire labels
         (secure.gb_step_fused).  (The reference runs GC then a separate
         OT round here, collect.rs:419-482.)"""
-        frontier = self._shard_frontier(shard)
         with self.obs.span("fss", level=level) as sp_fss:
             # dispatch time only: the FSS expansion itself overlaps the
             # exchange below (no sync — a block_until_ready here would
-            # cost a tunnel RTT)
-            packed, children = collect.expand_share_bits(
-                self.keys, frontier, level, want_children=not last
+            # cost a tunnel RTT); a pipelined leader already ran this
+            # stage at frame arrival (``_maybe_pre_expand``)
+            ex = self._expand_stage(level, last, shard)
+            children, frontier, flat = (
+                ex["children"], ex["frontier"], ex["flat"]
             )
-            d = self.keys.cw_seed.shape[1]
-            C, S = 1 << d, 2 * d
-            strs = secure.child_strings(packed, d)  # [F, C, N, S]
-            F_, _, N, _ = strs.shape
+            F_, C, N, S = ex["dims"]
             B = F_ * C * N
             self.obs.count("gc_tests", B, level=level)
             self.obs.gauge("ot_batch_size", B * S, level=level)
-            flat = strs.reshape(B, S)
         with self.obs.span("gc_ot", level=level) as sp_gc:
             w = secure.alive_weight(frontier.alive, self.alive_keys, C)
             # crawl counter makes every garbling's randomness unique even
@@ -838,6 +941,7 @@ class CollectorServer:
         # fhh-lint: disable=host-sync-in-hot-loop (wire input: host numpy)
         pat_bits = np.asarray(req["pattern_bits"], bool)
         n_alive = int(req["n_alive"])
+        self._expand_ready.clear()  # the frontier is about to mutate
         if self._children is None and self._shard_children:
             self._children = self._assemble_shard_children()
         if self._children is not None:  # cache from this level's crawl
@@ -880,6 +984,7 @@ class CollectorServer:
         stored leaf count shares down to the survivors
         (ref: collect.rs:931-942).  The sketch DPF does advance once more
         so its F255 leaf payloads can be verified post-prune."""
+        self._expand_ready.clear()  # leaf level: nothing expands past it
         if self._last_shares is None and self._shard_last:
             parts = sorted(self._shard_last.items())
             whole = np.concatenate([p for _, p in parts], axis=0)
@@ -1175,6 +1280,7 @@ class CollectorServer:
         self._shard_children.clear()
         self._shard_last.clear()
         self._shard_level = None
+        self._expand_ready.clear()
         if has_sketch:
             if self._sketch is None:
                 self._concat_sketch()
@@ -1222,6 +1328,90 @@ class CollectorServer:
         obs.emit("resilience.plane_reset", server=self.server_id)
         return True
 
+    async def plane_break(self, _req) -> bool:
+        """Forcibly close this server's end of the peer data plane WITHOUT
+        re-establishing it — the pipelined leader's quiesce primitive.  A
+        faulted pipeline can leave a verb on EITHER server blocked in a
+        ``_swap`` recv while holding the verb lock (its span reached only
+        one server, so the peer's matching frame never comes); this verb
+        dispatches OUTSIDE the verb lock (see ``_dispatch``) precisely so
+        it can break that wedge: the close fails the blocked read loudly,
+        the wedged verb errors out and releases the lock, and the
+        leader's subsequent (locked) ``plane_reset`` re-keys the plane
+        cleanly."""
+        w = self._peer_writer
+        if w is not None and not w.is_closing():
+            w.close()
+        self.obs.count("plane_breaks")
+        obs.emit("resilience.plane_break", server=self.server_id)
+        return True
+
+    async def warmup(self, req) -> dict:
+        """Pre-compile the per-``f_bucket`` crawl programs so bucket
+        recompiles stop billing into measured (or production) crawl time:
+        for every requested bucket (and every shard-span size it implies
+        under ``cfg.crawl_shard_nodes``), run the expand stage and — in
+        secure mode — the whole 2PC kernel chain against a THROWAWAY
+        in-process OT session with the real key batch's shapes.  Touches
+        no protocol state: the live OT sessions, frontier, and data plane
+        are never involved, so warmup can run any time after ``add_keys``
+        (the leader calls it right after ``tree_init``).  Returns the
+        number of (bucket, span) shapes warmed."""
+        if self.keys is None:
+            if not self.keys_parts:
+                raise RuntimeError("warmup before add_keys")
+            self._concat_keys()
+        buckets = sorted(
+            {int(b) for b in (req or {}).get("f_buckets", []) if int(b) > 0}
+        )
+        L = self.keys.cw_seed.shape[-2]
+        shapes = 0
+        with self.obs.span("warmup"):
+            for b in buckets:
+                sizes = {
+                    hi - lo
+                    for lo, hi in collect.shard_spans(
+                        b, self.cfg.crawl_shard_nodes
+                    )
+                }
+                for fb in sorted(sizes | {b}):
+                    self._warm_bucket(fb, L)
+                    shapes += 1
+                    # yield between compiles: each can take seconds, and
+                    # the control socket must keep answering keepalives
+                    await asyncio.sleep(0)
+        self.obs.count("warmup_shapes", shapes)
+        return {"shapes": shapes}
+
+    def _warm_bucket(self, fb: int, L: int) -> None:
+        """Compile (by running on throwaway inputs) every device program
+        a crawl at frontier bucket ``fb`` will hit: expand with and
+        without children, the trusted count reduction, and in secure
+        mode the OT-extension + equality + b2a + share-sum chain for both
+        FE62 (inner levels) and F255 (the leaf level)."""
+        fr = collect.tree_init(self.keys, fb)
+        d = self.keys.cw_seed.shape[1]
+        lasts = (False, True) if L > 1 else (True,)
+        for last in lasts:
+            level = L - 1 if last else 0
+            packed, _ = collect.expand_share_bits(
+                self.keys, fr, level, want_children=not last
+            )
+            if self.cfg.secure_exchange:
+                secure.warm_level_kernels(
+                    packed, d, F255 if last else FE62
+                )
+            else:
+                masks = collect.pattern_masks(d)
+                jax.block_until_ready(
+                    collect.counts_by_pattern(
+                        packed, packed, masks, self.alive_keys
+                        if self.alive_keys is not None
+                        else np.ones(self.keys.cw_seed.shape[0], bool),
+                        fr.alive,
+                    )
+                )
+
     # -- wiring ----------------------------------------------------------
 
     _VERBS = (
@@ -1239,6 +1429,8 @@ class CollectorServer:
         "tree_checkpoint",
         "tree_restore",
         "plane_reset",
+        "plane_break",  # pipelined-crawl quiesce (unlocked dispatch)
+        "warmup",  # per-f_bucket compile warmup (no protocol state)
     )
 
     def _bind_session(self, req) -> _Session | None:
@@ -1296,9 +1488,16 @@ class CollectorServer:
                 asyncio.get_event_loop().create_future()
             )
         try:
-            if verb == "add_keys":  # append-only; no awaits -> atomic
-                resp = await self.add_keys(req)
+            if verb in ("add_keys", "plane_break"):
+                # add_keys: append-only, no awaits -> atomic.  plane_break
+                # MUST bypass the lock: it exists to break a verb wedged
+                # on the data plane while HOLDING the lock (pipelined
+                # quiesce) — behind the lock it could never run.
+                resp = await getattr(self, verb)(req)
             else:
+                # frame-arrival expand stage: overlap a sharded crawl's
+                # device work with the span currently holding the lock
+                self._maybe_pre_expand(verb, req)
                 async with self._verb_lock:
                     resp = await getattr(self, verb)(req)
         # fhh-lint: disable=broad-except (RPC boundary: EVERY failure
@@ -1602,6 +1801,7 @@ class CollectorClient:
         self._r = self._w = None
         self._send_lock = asyncio.Lock()
         self._conn_lock = asyncio.Lock()
+        self._flush_task: asyncio.Task | None = None
         self._pending: dict[int, asyncio.Future] = {}
         self._next_id = 0
         self._reader_task: asyncio.Task | None = None
@@ -1627,6 +1827,8 @@ class CollectorClient:
             self._reader_task.cancel()
         if self._w is not None and not self._w.is_closing():
             self._w.close()
+        if self._flush_task is not None and not self._flush_task.done():
+            self._flush_task.set_exception(self._dead)
         self._fail_pending(self._dead)
 
     def _fail_pending(self, err: ConnectionError) -> None:
@@ -1685,6 +1887,14 @@ class CollectorClient:
             self._fail_pending(
                 ConnectionError("transport replaced by reconnect")
             )
+            # a coalesced drain still waiting on the old transport can
+            # never finish — fail it (ConnectionError = transient, so its
+            # waiters replay on the fresh epoch) and start clean
+            if self._flush_task is not None and not self._flush_task.done():
+                self._flush_task.set_exception(
+                    ConnectionError("transport replaced by reconnect")
+                )
+            self._flush_task = None
             self._r, self._w = r, w
             self.epoch += 1
             self._reader_task = asyncio.ensure_future(self._read_loop(r))
@@ -1732,13 +1942,50 @@ class CollectorClient:
                 await _send(
                     self._w, (req_id, verb, req or {}),
                     count=lambda n: self.obs.count("control_bytes_sent", n),
+                    flush=False,
                 )
+            # coalesced drain: a burst of concurrent frames (the 256-deep
+            # upload window, a pipelined level's span verbs) shares ONE
+            # drain instead of one await per frame — backpressure is
+            # still applied, once per burst
+            await self._flush()
             return await deadline.wait_for(fut)
         finally:
             # send raised mid-write, the wait timed out, or the reader
             # failed the future: either way the response slot is dead —
             # drop it so _pending can't grow across failed calls
             self._pending.pop(req_id, None)
+
+    async def _flush(self) -> None:
+        """Shared, coalesced ``drain()``: every writer since the last
+        flush awaits the SAME drain outcome (a future the drain helper
+        resolves).  Shielded so one caller's cancellation (a verb
+        deadline firing) cannot starve the other writers; a drain
+        failure (dead transport) surfaces to every waiter as the same
+        connection-shaped error the per-frame drain raised — and a
+        reconnect fails the stale flush with ConnectionError (see
+        ``_ensure_connected``), which the retry loop classifies as
+        transient and replays through."""
+        fut = self._flush_task
+        if fut is None or fut.done():
+            fut = self._flush_task = asyncio.get_event_loop().create_future()
+            # no "never retrieved" GC noise if every waiter was cancelled
+            fut.add_done_callback(
+                lambda f: f.exception() if not f.cancelled() else None
+            )
+
+            async def do_drain(w=self._w, fut=fut):
+                try:
+                    await w.drain()
+                except Exception as e:  # fhh-lint: disable=broad-except (outcome relay: every drain failure must reach the coalesced waiters, whatever its type)
+                    if not fut.done():
+                        fut.set_exception(e)
+                else:
+                    if not fut.done():
+                        fut.set_result(None)
+
+            asyncio.ensure_future(do_drain())
+        await asyncio.shield(fut)
 
     async def _read_loop(self, reader):
         try:

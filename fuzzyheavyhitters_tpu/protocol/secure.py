@@ -462,3 +462,39 @@ def alive_weight(alive_nodes, alive_keys, C: int) -> np.ndarray:
     return np.broadcast_to(
         a_n[:, None, None] & a_k[None, None, :], (a_n.shape[0], C, a_k.shape[0])
     )
+
+
+# ---------------------------------------------------------------------------
+# Warmup: compile the per-shape kernel chain without touching live state
+# ---------------------------------------------------------------------------
+
+
+def warm_level_kernels(packed, d: int, field) -> None:
+    """Run the WHOLE per-level 2PC kernel chain — string extraction,
+    Δ-OT extension, equality (1-of-4 OT or GC + fused b2a, whichever this
+    shape uses), payload open, alive-gated share sums — on a THROWAWAY
+    in-process OT session, so every jit program a real level of this
+    shape will dispatch is compiled (and lands in the persistent compile
+    cache, utils/compile_cache) before measured crawl time starts.  The
+    live OT sessions and the data plane are never touched; the outputs
+    are discarded."""
+    strs = child_strings(packed, d)
+    F_, C, N, S = strs.shape
+    B = F_ * C * N
+    flat = strs.reshape(B, S)
+    snd, rcv = otext.inprocess_pair()
+    zero = np.zeros(4, np.uint32)
+    gseed, bseed = derive_seed(zero, 1, 0), derive_seed(zero, 2, 0)
+    u, t_rows, idx0 = ev_step1_fused(rcv, flat)
+    if _ot4_use(S):
+        msg, _ = gb_step_ot4(snd, u, flat, bseed, field, 0)
+        vals = ev_open_ot4(rcv, t_rows, flat, msg, B, field, idx0)
+    else:
+        msg, _ = gb_step_fused(snd, u, flat, gseed, bseed, field, 0)
+        vals = ev_open_fused(rcv, t_rows, msg, B, S, field, idx0)
+    w = jnp.ones((F_, C, N), bool)
+    jax.block_until_ready(
+        node_share_sums(
+            field, vals.reshape((F_, C, N) + field.limb_shape), w
+        )
+    )

@@ -1,0 +1,67 @@
+"""Persistent XLA compilation cache wiring (``FHH_COMPILE_CACHE``).
+
+Compile churn is a first-order cost of the crawl: every new frontier
+bucket size (1 -> 2 -> 4 ... as sites' prefixes separate) recompiles the
+expand / GC / OT programs, and through a remote-chip tunnel each compile
+is tens of seconds of wall-clock billed into whatever happens to run
+first — in bench.py's case, into the measured sections and ultimately
+past the harness budget (BENCH_r05 rc=124).  JAX ships a persistent
+on-disk compilation cache keyed by the HLO fingerprint; pointing it at a
+stable directory makes every *repeat* compile (a second bench section, a
+restarted server, the next bench round) a cache read instead.
+
+``enable()`` is idempotent and safe everywhere: it reads
+``FHH_COMPILE_CACHE`` (a directory path; created if missing), configures
+``jax.config.jax_compilation_cache_dir`` plus the thresholds that would
+otherwise skip small/fast programs, and returns the path — or ``None``
+when the knob is unset or this JAX build lacks the config (the crawl
+then simply recompiles as before).  The binaries (bin/leader, bin/server,
+bin/mesh) and bench.py call it at startup; bench additionally defaults
+the knob for its child processes so all sections share one cache.
+"""
+
+from __future__ import annotations
+
+import os
+
+_enabled: str | None = None
+
+
+def enable(path: str | None = None) -> str | None:
+    """Wire JAX's persistent compilation cache at ``path`` (default:
+    ``$FHH_COMPILE_CACHE``).  Returns the directory in use, or ``None``
+    when disabled/unsupported.  Idempotent — the first successful call
+    wins; later calls return the established path."""
+    global _enabled
+    if _enabled is not None:
+        return _enabled
+    path = path or os.environ.get("FHH_COMPILE_CACHE")
+    if not path:
+        return None
+    import jax
+
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # default thresholds skip sub-second / sub-MB programs — exactly
+        # the per-bucket expand/GC kernels whose churn this exists to kill
+        for knob, val in (
+            ("jax_persistent_cache_min_compile_time_secs", 0.0),
+            ("jax_persistent_cache_min_entry_size_bytes", -1),
+        ):
+            try:
+                jax.config.update(knob, val)
+            except (AttributeError, ValueError):
+                pass  # older JAX: the cache still works, thresholds stay
+    except (AttributeError, ValueError, OSError) as e:
+        from .. import obs
+
+        obs.emit(
+            "compile_cache.unavailable",
+            severity="warn",
+            path=path,
+            error=f"{type(e).__name__}: {e}",
+        )
+        return None
+    _enabled = path
+    return path

@@ -31,6 +31,13 @@ Extra TPU-native knobs (all defaulted so reference configs load unchanged):
   this many frontier slots, one RPC verb per shard — a mid-level fault
   then re-runs only the lost shards (protocol/leader_rpc.py shard retry).
   0 (default) keeps one verb per level.
+- ``crawl_pipeline_depth``: how many shard verbs the leader keeps in
+  flight at once on a sharded level (protocol/leader_rpc.py pipelined
+  crawl): span k's GC/OT network phase overlaps span k+1's device
+  expand.  1 (default) is the sequential PR-4 path; values > 1 require
+  ``crawl_shard_nodes`` > 0 to have any effect.  Results are
+  bit-identical either way; on any in-flight fault the pipeline
+  quiesces and falls back to the sequential per-span retry.
 """
 
 from __future__ import annotations
@@ -62,6 +69,10 @@ class Config:
     # (each shard is its own RPC verb — a mid-level fault re-runs only
     # the lost shards, protocol/leader_rpc.py).  0 disables sharding.
     crawl_shard_nodes: int = 0
+    # sharded-crawl pipelining: shard verbs the leader keeps in flight
+    # (1 = sequential; >1 overlaps span k's plane I/O with span k+1's
+    # device expand — protocol/leader_rpc.py pipelined crawl)
+    crawl_pipeline_depth: int = 1
 
 
 def load_config(path: str) -> Config:

@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Budgeted bench smoke: the CI guard for the bench output contract.
+#
+# Usage: scripts/bench_smoke.sh [budget_seconds]
+#   - runs `python bench.py` in SMOKE mode (FHH_BENCH_SMOKE=1: tiny
+#     CPU-safe shapes — np-engine keygen + a small pipelined secure
+#     crawl with its sequential bit-identity assertion; the heavyweight
+#     chip sections report {"skipped": "smoke"}) under a wall-clock
+#     budget (FHH_BENCH_BUDGET, default 480 s)
+#   - FAILS unless the bench exits rc=0 AND its last stdout line is
+#     parseable JSON carrying the headline metric — exactly what the
+#     harness needs (BENCH_r04 printed an oversized line that parsed as
+#     null; BENCH_r05 timed out with no line at all; both fail here)
+#   - also asserts the line stays under the harness's ~2000-byte stdout
+#     tail capture
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+budget="${1:-480}"
+out="$(mktemp)"
+
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" FHH_BENCH_SMOKE=1 \
+    FHH_BENCH_BUDGET="$budget" \
+    timeout -k 10 "$((budget + 60))" python bench.py > "$out" 2> "$out.err"
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "bench_smoke: bench.py exited rc=$rc" >&2
+    tail -5 "$out.err" >&2
+    rm -f "$out" "$out.err"
+    exit 1
+fi
+
+python - "$out" <<'EOF'
+import json, sys
+
+lines = [l for l in open(sys.argv[1]).read().splitlines() if l.strip()]
+assert lines, "bench printed nothing"
+last = lines[-1]
+assert len(last) < 2000, (
+    f"final JSON line is {len(last)} bytes — exceeds the harness's "
+    "stdout tail capture and would parse as null"
+)
+doc = json.loads(last)
+assert "metric" in doc and doc.get("value") is not None, doc
+sc = doc.get("extra", {}).get("secure_crawl", {})
+assert "secure_clients_per_sec" in sc, (
+    "secure_crawl section missing from the compact line: " + last[:300]
+)
+print(
+    "bench_smoke OK: "
+    f"{doc['metric']}={doc['value']}, "
+    f"secure_clients_per_sec={sc['secure_clients_per_sec']}, "
+    f"pipeline_speedup={sc.get('pipeline_speedup')}, "
+    f"line={len(last)}B, elapsed={doc.get('budget', {}).get('elapsed_s')}s"
+)
+EOF
+rc=$?
+rm -f "$out" "$out.err"
+exit $rc

@@ -285,9 +285,9 @@ def test_secure_socket_run_matches_trusted(rng, monkeypatch, eq_ot4):
     real_send = rpc._send
     real_expand = collect.expand_share_bits
 
-    async def spy_send(writer, obj, count=None):
+    async def spy_send(writer, obj, count=None, flush=True):
         sent.append(obj)
-        await real_send(writer, obj, count)
+        await real_send(writer, obj, count, flush)
 
     def spy_expand(keys, frontier, level, **kw):
         packed, children = real_expand(keys, frontier, level, **kw)
