@@ -610,23 +610,31 @@ def bench_secure(n=1024, L=12, port=39831, shard_nodes=4, pipeline_depth=4):
     """Secure-mode aggregate crawl: both collector servers in one process
     with the REAL 2PC data plane (secure_exchange=true), full level loop
     over localhost sockets on the default device.  End-to-end wall time.
-    A level is ONE protocol round trip — ev u -> sender table (the 1-of-4
-    chosen-payload-OT fast path at this 1-dim shape; the GC+fused-b2a
-    flow for S > 2) — so the tunnel floor is ~3 serial device<->host
-    fetches per level (u, table, shares) at the reported
-    ``device_fetch_rtt_ms`` (~0.1 s); round 4's two-round flow measured
-    ~10.  Still a lower bound on what adjacent hardware achieves;
+    A level is ONE protocol round trip — ev u -> sender's whole-level
+    planar message (the 1-of-2^S chosen-payload table at this 1-dim
+    shape; the packed garbled batch past secure.OT2S_MAX_S) — so the
+    tunnel floor is ~3 serial device<->host fetches per level (u, table,
+    shares) at the reported ``device_fetch_rtt_ms`` (~0.1 s).  Still a
+    lower bound on what adjacent hardware achieves;
     ``bench_secure_device`` is the adjacent-chip number.
     Ref seam: collect.rs:419-482 inside tree_crawl.
 
-    Round-6 shape: the headline run is PIPELINED — each level splits into
-    node-axis spans (``crawl_shard_nodes``) and the leader keeps up to
-    ``crawl_pipeline_depth`` span verbs in flight, so span k's GC/OT
-    network phase (the 13 s of 18.2 s in BENCH_r04) overlaps span k+1's
-    device expand + fetch.  A SEQUENTIAL run on the same warmed servers
-    rides along as the comparison point, and the results of the two are
-    asserted bit-identical.  Compiles are excluded from both timings via
-    the per-``f_bucket`` warmup verb (plus ``FHH_COMPILE_CACHE``)."""
+    Round-7 shape: the HEADLINE run is WHOLE-LEVEL — every (node,
+    client) wire of a level garbles/evaluates as one fused device
+    program per side (``secure_whole_level``, the default), with the
+    secure-kernel phase split (otext/garble/eval/b2a) captured from the
+    server registries.  Three comparison legs ride along on the same
+    warmed servers: the round-6 sharded+pipelined run, its sequential
+    form (``pipeline_speedup`` keeps its meaning), and a GC-path
+    (``ot_path="gc"``) sequential reference — and ALL results are
+    asserted bit-identical before anything is reported, so the fused
+    1-of-2^S path never reports numbers it didn't earn.  Compiles are
+    excluded from every timing via the per-``f_bucket`` warmup verb
+    (plus ``FHH_COMPILE_CACHE``).  NB: the planar wire pads every GC/OT
+    batch to ``gc_pallas.padded_tests`` (8192 tests), so at tiny smoke
+    shapes the SHARDED leg pays the padding floor once per span and its
+    ``pipeline_speedup`` reads < 1 — meaningful only at production
+    shapes where spans amortize the floor."""
     import asyncio
     import dataclasses
 
@@ -652,53 +660,83 @@ def bench_secure(n=1024, L=12, port=39831, shard_nodes=4, pipeline_depth=4):
     )
 
     async def run():
-        lead, c0, c1, s0, _ = await _bring_up_pair(cfg, port)
+        lead, c0, c1, s0, s1 = await _bring_up_pair(cfg, port)
+
+        async def timed_leg(leg_cfg, warm=False):
+            leg = RpcLeader(leg_cfg, c0, c1)
+            await asyncio.gather(c0.call("reset"), c1.call("reset"))
+            await leg.upload_keys(k0, k1)
+            if warm:
+                # legs whose shapes the headline warmup cannot cover
+                # (span-sized sharded programs, the GC path) warm their
+                # own program ladder OFF the timed clock
+                await leg.warmup()
+            t = time.perf_counter()
+            res = await leg.run(n)
+            return res, time.perf_counter() - t, leg
+
         await lead.upload_keys(k0, k1)
-        await lead.warmup()  # per-f_bucket (and per-span-size) compiles
+        await lead.warmup()  # per-f_bucket compiles, off the clock
         res = await lead.run(n)  # warm: any residual compile/trace cost
         assert res.paths.shape[0] >= 1
-        # timed PIPELINED run (the headline)
-        await asyncio.gather(c0.call("reset"), c1.call("reset"))
-        await lead.upload_keys(k0, k1)
-        # the LEADER registry is never reset (the reset verb clears the
-        # servers' registries only): snapshot its totals so the reported
-        # overlap/stalls are the timed run's DELTA, not warm+timed
-        overlap0 = lead.obs.timer_seconds("pipeline_overlap")
-        stalls0 = lead.obs.counter_value("pipeline_stalls")
-        t = time.perf_counter()
-        res_p = await lead.run(n)
-        dt_p = time.perf_counter() - t
-        # server 0's telemetry registry snapshot — the machine-readable
-        # successor of the phase-timer stdout scrape (reset above cleared
-        # the warm run's accounting, so this covers the timed run only)
+        # timed HEADLINE: whole-level fused kernels (the default config)
+        res_w, dt_w, _ = await timed_leg(cfg)
+        # secure-kernel phase split of the timed run, BOTH servers (the
+        # garbler role alternates per level, so each registry holds half
+        # of every phase; reset above cleared the warm run's accounting)
         rep = s0.obs.report()
-        overlap = lead.obs.timer_seconds("pipeline_overlap") - overlap0
-        stalls = int(lead.obs.counter_value("pipeline_stalls") - stalls0)
-        # timed SEQUENTIAL comparison on the same warmed servers: the
-        # shard/pipeline knobs live leader-side only, so a second leader
-        # with them off drives the identical servers the PR-4 way
-        seq = RpcLeader(
+        rep1 = s1.obs.report()
+        # timed sharded+pipelined comparison (the round-6 headline);
+        # the pipeline telemetry lives entirely on this leg's own fresh
+        # leader registry (the whole-level legs emit none)
+        pipe_cfg = dataclasses.replace(cfg, secure_whole_level=False)
+        res_p, dt_p, pipe_lead = await timed_leg(pipe_cfg, warm=True)
+        overlap = pipe_lead.obs.timer_seconds("pipeline_overlap")
+        stalls = int(pipe_lead.obs.counter_value("pipeline_stalls"))
+        # timed SEQUENTIAL comparison (PR-4 path, same warmed servers)
+        res_s, dt_s, _ = await timed_leg(
             dataclasses.replace(
-                cfg, crawl_shard_nodes=0, crawl_pipeline_depth=1
-            ),
-            c0, c1,
+                cfg, crawl_shard_nodes=0, crawl_pipeline_depth=1,
+                secure_whole_level=False,
+            )
         )
-        await asyncio.gather(c0.call("reset"), c1.call("reset"))
-        await seq.upload_keys(k0, k1)
-        t = time.perf_counter()
-        res_s = await seq.run(n)
-        dt_s = time.perf_counter() - t
-        # the acceptance contract: pipelined == sequential, bit for bit
-        assert np.array_equal(res_p.counts, res_s.counts)
-        assert np.array_equal(res_p.paths, res_s.paths)
-        return dt_p, dt_s, overlap, stalls, int(res_p.paths.shape[0]), rep
+        # GC-path sequential reference: the fused 1-of-2^S headline must
+        # be bit-identical to the garbled-circuit oracle before any
+        # number is reported
+        res_g, dt_g, _ = await timed_leg(
+            dataclasses.replace(
+                cfg, ot_path="gc", crawl_shard_nodes=0,
+                crawl_pipeline_depth=1,
+            ),
+            warm=True,
+        )
+        for other in (res_p, res_s, res_g):
+            assert np.array_equal(res_w.counts, other.counts)
+            assert np.array_equal(res_w.paths, other.paths)
+        return (dt_w, dt_p, dt_s, dt_g, overlap, stalls,
+                int(res_w.paths.shape[0]), rep, rep1)
 
-    dt, dt_seq, overlap_s, stalls, hitters, rep = asyncio.run(run())
+    (dt, dt_pipe, dt_seq, dt_gc, overlap_s, stalls, hitters, rep,
+     rep1) = asyncio.run(run())
     phases, ctrs = rep["phases"], rep["counters"]
     zero = {"seconds": 0.0, "total": 0}
     fss, gcot, fld = (
         round(phases.get(k, zero)["seconds"], 3)
         for k in ("fss", "gc_ot", "field")
+    )
+    # secure-kernel split: sum both servers' registries per phase; the
+    # path taken comes from the ot_path_* counters (ot2s at this 1-dim
+    # shape unless EQ_OT4 is off)
+    kernel = {}
+    for k in ("otext", "garble", "eval", "b2a"):
+        kernel[f"phase_{k}_seconds"] = round(
+            phases.get(k, zero)["seconds"]
+            + rep1["phases"].get(k, zero)["seconds"], 3
+        )
+    n_ot2s = int(ctrs.get("ot_path_ot2s", zero)["total"])
+    n_gc = int(ctrs.get("ot_path_gc", zero)["total"])
+    kernel["ot_path"] = (
+        "mixed" if (n_ot2s and n_gc) else ("gc" if n_gc else "ot2s")
     )
     gc_tests = int(ctrs.get("gc_tests", zero)["total"])
     # the e2e floor: every device->host fetch in the serial 2PC message
@@ -717,11 +755,20 @@ def bench_secure(n=1024, L=12, port=39831, shard_nodes=4, pipeline_depth=4):
         "data_len": L,
         "ms_per_level_e2e": round(dt / L * 1000, 2),
         "hitters": hitters,
+        # the whole-level fused-kernel phase split + path of the timed
+        # headline run — the ROADMAP's acceptance instrument
+        "secure_kernel": kernel,
+        # whole-level vs the round-6 sharded+pipelined path, and the
+        # garbled-circuit sequential oracle everything was asserted
+        # bit-identical against
+        "whole_level_speedup_vs_pipelined": round(dt_pipe / dt, 2),
+        "gc_reference_clients_per_sec": round(n / dt_gc, 1),
         # pipelined-vs-sequential on the same warmed servers (results
         # asserted bit-identical inside the run)
+        "pipelined_clients_per_sec": round(n / dt_pipe, 1),
         "sequential_clients_per_sec": round(n / dt_seq, 1),
         "sequential_ms_per_level": round(dt_seq / L * 1000, 2),
-        "pipeline_speedup": round(dt_seq / dt, 2),
+        "pipeline_speedup": round(dt_seq / dt_pipe, 2),
         "pipeline": {
             "depth": cfg.crawl_pipeline_depth,
             "shard_nodes": cfg.crawl_shard_nodes,
@@ -1365,13 +1412,15 @@ _COMPACT_KEYS = {
     "crawl": ("aggregate_clients_per_sec", "ms_per_level_device"),
     "crawl_hbm_max": ("clients_per_sec_steady", "crawl_seconds_e2e"),
     "secure_crawl": (
-        "secure_clients_per_sec", "ms_per_level_e2e",
-        "sequential_clients_per_sec", "pipeline_speedup", "pipeline",
+        "secure_clients_per_sec", "ms_per_level_e2e", "secure_kernel",
+        "whole_level_speedup_vs_pipelined",
+        "sequential_clients_per_sec", "pipeline_speedup",
     ),
     # _PARTIAL's key for the same section (the partial-dump path)
     "secure": (
-        "secure_clients_per_sec", "ms_per_level_e2e",
-        "sequential_clients_per_sec", "pipeline_speedup", "pipeline",
+        "secure_clients_per_sec", "ms_per_level_e2e", "secure_kernel",
+        "whole_level_speedup_vs_pipelined",
+        "sequential_clients_per_sec", "pipeline_speedup",
     ),
     "secure_device": (
         "secure_device_clients_per_sec", "secure_device_ms_per_level_fe62",

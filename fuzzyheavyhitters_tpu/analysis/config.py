@@ -86,6 +86,14 @@ class LintConfig:
         "fuzzyheavyhitters_tpu/resilience",
         "fuzzyheavyhitters_tpu/parallel",
     )
+    # chunked-device-readback rule: secure-kernel hot roots where a loop
+    # of per-chunk device readbacks (incl. the sanctioned _fetch helper)
+    # must never grow back — the whole-level batching this repo's
+    # secure path rests on
+    readback_modules: tuple = (
+        "fuzzyheavyhitters_tpu/protocol/secure.py",
+        "fuzzyheavyhitters_tpu/ops",
+    )
     severity_overrides: dict = field(default_factory=dict)
     baseline: str = "lint_baseline.json"
     default_paths: tuple = ("fuzzyheavyhitters_tpu", "tests")
@@ -205,6 +213,7 @@ def load_config(root: str | None = None, pyproject: str | None = None) -> LintCo
         "print_allowed",
         "shared_state_modules",
         "await_modules",
+        "readback_modules",
         "default_paths",
     ):
         val = section.get(key)

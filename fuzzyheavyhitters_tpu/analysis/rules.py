@@ -1,7 +1,7 @@
 """The fhh-lint rule set, tuned to this codebase's invariants.
 
-Seven rules over six concerns (the broad-except/bare-print concern ships
-as two rules so suppressions and severities stay per-rule):
+Eight rules over seven concerns (the broad-except/bare-print concern
+ships as two rules so suppressions and severities stay per-rule):
 
 - ``host-sync-in-hot-loop`` — device->host synchronization primitives
   (``.item()``, ``np.asarray``, ``jax.device_get``,
@@ -33,6 +33,15 @@ as two rules so suppressions and severities stay per-rule):
 - ``bare-print`` — ``print()`` in crawl-path package modules (the
   ``test_obs`` stdout-hygiene guard, generalized): telemetry goes
   through ``obs.emit``; stdout stays a clean program-output channel.
+- ``chunked-device-readback`` — device->host readbacks (``_fetch``,
+  ``np.asarray``, ``jax.device_get``, ``.copy_to_host_async()``) inside
+  loops in the secure-kernel hot roots (``readback_modules``).  A loop
+  of per-chunk fetches serializes the crawl on one device round trip
+  per chunk — the exact pattern the whole-level kernel restructure
+  removed; the rule pins it at zero.  Deliberately overlaps host-sync
+  on ``np.asarray`` (both fire) and deliberately covers ``_fetch``,
+  which host-sync sanctions: counted and off-loop does not make a loop
+  of fetches cheap.
 - ``unbounded-await`` — ``await`` on network reads (``readexactly``,
   ``read``, ...), ``asyncio.wait``, event waits, or dials carrying no
   timeout/deadline, in the configured transport modules
@@ -618,7 +627,85 @@ class BarePrint(Rule):
 
 
 # ---------------------------------------------------------------------------
-# 7. unbounded-await
+# 7. chunked-device-readback
+# ---------------------------------------------------------------------------
+
+# device->host readback entry points: the sanctioned counted fetch
+# (protocol.rpc._fetch), the raw bulk fetches, and the async-DMA kickoff
+_READBACK_DOTTED = {
+    "np.asarray": "np.asarray",
+    "numpy.asarray": "np.asarray",
+    "jax.device_get": "jax.device_get",
+    "device_get": "jax.device_get",
+}
+
+
+class ChunkedDeviceReadback(Rule):
+    """Device readbacks inside per-chunk loops in the secure-kernel hot
+    roots (``readback_modules``): a loop that fetches (or starts the DMA
+    for) one chunk per iteration serializes the crawl on one device
+    round trip PER CHUNK — through a remote-chip tunnel each is a full
+    ~0.1 s RTT regardless of size.  The whole-level restructure exists
+    to batch these into ONE fetch per level; this rule keeps the pattern
+    from growing back.  Note the sanctioned ``_fetch`` helper is flagged
+    here too — being counted and off-loop does not make a per-chunk loop
+    of fetches cheap — which is exactly the gap the host-sync rule (which
+    deliberately never flags ``_fetch``) leaves open."""
+
+    name = "chunked-device-readback"
+    default_severity = "warning"
+
+    _LOOPY = (
+        ast.For, ast.AsyncFor, ast.While,
+        # a comprehension of fetches is the same per-chunk pathology
+        ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp,
+    )
+
+    def check(self, mod: SourceModule, cfg):
+        if not _under_prefix(mod.relpath, cfg.readback_modules):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = self._readback_kind(node)
+            if kind is None:
+                continue
+            if not self._in_chunk_loop(mod, node):
+                continue
+            yield (
+                *_span(node),
+                f"{kind} inside a per-chunk loop costs one device round "
+                "trip per iteration — batch the chunks into one "
+                "whole-level readback (stack on device, fetch once after "
+                "the loop)",
+            )
+
+    @classmethod
+    def _in_chunk_loop(cls, mod: SourceModule, node: ast.AST) -> bool:
+        for a in mod.ancestors(node):
+            if isinstance(a, cls._LOOPY):
+                return True
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+        return False
+
+    @staticmethod
+    def _readback_kind(call: ast.Call) -> str | None:
+        dn = dotted_name(call.func)
+        if dn in _READBACK_DOTTED:
+            return _READBACK_DOTTED[dn]
+        if last_segment(dn) == "_fetch":
+            return "_fetch"  # self._fetch / rpc._fetch forms
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == "copy_to_host_async"
+        ):
+            return ".copy_to_host_async()"
+        return None
+
+
+# ---------------------------------------------------------------------------
+# 8. unbounded-await
 # ---------------------------------------------------------------------------
 
 # attribute calls whose await can hang forever on a wedged/black-holed
@@ -699,6 +786,7 @@ ALL_RULES: tuple[Rule, ...] = (
     UnguardedSharedState(),
     BroadExcept(),
     BarePrint(),
+    ChunkedDeviceReadback(),
     UnboundedAwait(),
 )
 

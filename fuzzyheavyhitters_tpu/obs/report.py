@@ -23,6 +23,14 @@ Well-known metric names (what populates them):
   3-phase server taxonomy (protocol/rpc.py crawl verbs; trusted mode's
   ``gc_ot`` slot is the plaintext exchange), plus ``level`` on the
   leader/driver side and ``upload_keys`` / ``setup`` one-offs.
+- phases ``otext`` / ``garble`` / ``eval`` / ``b2a`` — the secure-kernel
+  split of ``gc_ot`` (extension, circuit garble/eval — zero on the
+  1-of-2^S path — and payload-table/open + field conversion); they are
+  a BREAKDOWN of gc_ot, not additive with it, and the wire wait is the
+  gc_ot remainder.  Counters ``ot_path_ot2s`` / ``ot_path_gc`` count
+  levels by the equality-test engine taken.  Rolled up across
+  registries into a top-level ``secure_kernels`` section whenever a
+  secure crawl ran.
 - counters ``data_bytes_sent`` / ``data_bytes_recv`` /
   ``data_msgs_sent`` — server↔server data plane, per level;
   ``control_bytes_*`` — leader↔server control plane;
@@ -101,6 +109,9 @@ def run_report(registries=None) -> dict:
     pipe = _pipeline_summary(out)
     if pipe is not None:
         doc["pipeline"] = pipe
+    sk = _secure_kernel_summary(out)
+    if sk is not None:
+        doc["secure_kernels"] = sk
     if dropped:
         doc["dropped_registries"] = dropped
     return doc
@@ -196,6 +207,56 @@ def _pipeline_summary(registries: dict) -> dict | None:
                 "stalls": stall_by.get(lvl, 0),
             }
             for lvl in levels
+        },
+    }
+
+
+def _secure_kernel_summary(registries: dict) -> dict | None:
+    """Cross-registry secure-kernel rollup (the acceptance instrument of
+    the device-resident GC/OT work): per phase, total seconds summed
+    across every registry (garbler and evaluator roles alternate per
+    level, so one server's registry holds half of each phase), plus the
+    per-level union breakdown and the equality-test path actually taken
+    (``ot2s`` / ``gc`` / ``mixed`` from the ot_path_* counters).
+    Present only when a secure crawl ran — trusted runs never emit these
+    metrics."""
+    names = ("otext", "garble", "eval", "b2a")
+    totals = dict.fromkeys(names, 0.0)
+    by_level: dict = {}
+    paths = {"ot2s": 0, "gc": 0}
+    seen = False
+    for snap in registries.values():
+        phases = snap.get("phases", {})
+        for n in names:
+            t = phases.get(n)
+            if t is None:
+                continue
+            seen = True
+            totals[n] += t.get("seconds", 0.0)
+            for lvl, s in t.get("by_level", {}).items():
+                by_level.setdefault(lvl, dict.fromkeys(names, 0.0))
+                by_level[lvl][n] += s
+        for p in paths:
+            c = snap.get("counters", {}).get(f"ot_path_{p}")
+            if c is not None:
+                seen = True
+                paths[p] += c.get("total", 0)
+    if not seen:
+        return None
+    if paths["ot2s"] and paths["gc"]:
+        ot_path = "mixed"
+    elif paths["gc"]:
+        ot_path = "gc"
+    else:
+        ot_path = "ot2s"
+    return {
+        "ot_path": ot_path,
+        "levels_ot2s": paths["ot2s"],
+        "levels_gc": paths["gc"],
+        **{f"{n}_seconds": round(totals[n], 6) for n in names},
+        "by_level": {
+            lvl: {n: round(v[n], 6) for n in names}
+            for lvl, v in sorted(by_level.items(), key=lambda kv: int(kv[0]))
         },
     }
 

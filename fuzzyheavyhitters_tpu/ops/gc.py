@@ -342,3 +342,118 @@ def _eval_equality_payload_xla(batch: GarbledEqBatch, ev_labels, cts,
     pad = ot_hash(out, n_words, idx_offset)
     ct = jnp.where(s[:, None], cts[1], cts[0])
     return s ^ batch.decode, ct ^ pad
+
+
+# ---------------------------------------------------------------------------
+# Whole-level PACKED flow: the planar wire format (gc_pallas layout)
+# ---------------------------------------------------------------------------
+#
+# The packed entry points emit/consume the garbled message as the planar
+# plane stack of ops/gc_pallas.py (``tables | gb_labels | decode | cts``
+# planes, each ``padded_tests(B)`` words).  On the Pallas engine that
+# buffer is the kernel's output raveled in place — the garble→pack and
+# unpack→eval transposes of the test-major wire format disappear.  The
+# XLA twins here planarize explicitly and are BYTE-IDENTICAL, so the wire
+# format (like every GC test vector) stays engine-independent and a
+# CPU-engine endpoint interoperates with a Pallas-engine one.
+
+
+def _pad_tests(a, bp: int):
+    """Zero-pad the leading (test) axis to ``bp`` — the XLA twin garbles
+    the padded slots exactly like the Pallas kernel does (zero-padded
+    planar inputs), so the packed wire buffers are BYTE-identical
+    engine-to-engine, padding included.  The receiver discards the pad
+    slots either way."""
+    B = a.shape[0]
+    if bp == B:
+        return a
+    return jnp.concatenate(
+        [a, jnp.zeros((bp - B,) + a.shape[1:], a.dtype)]
+    )
+
+
+@partial(jax.jit, static_argnames=("n_words",))
+def _garble_equality_payload_packed_xla(R, Y0, seed, x_bits, m_v0, m_v1,
+                                        n_words: int, idx_offset):
+    from . import gc_pallas
+    from .otext import ot_hash
+
+    x_bits = jnp.asarray(x_bits, bool)
+    B, S = x_bits.shape
+    bp = gc_pallas.padded_tests(B)
+    # the garbler's own labels + mask are drawn for the REAL B tests
+    # (the same stream draw as every other engine/flow), then padded —
+    # matching the kernel's zero-padded planar inputs bit for bit
+    _, (X0,), mask = _carve_label_words(seed, B, S, 1, with_r=False)
+    R = jnp.asarray(R, jnp.uint32)
+    batch, out0 = _garble_core(
+        R, _pad_tests(X0, bp),
+        _pad_tests(jnp.asarray(Y0, jnp.uint32), bp),
+        _pad_tests(mask, bp), _pad_tests(x_bits, bp),
+    )
+    h0 = ot_hash(out0, n_words, idx_offset)
+    h1 = ot_hash(out0 ^ R, n_words, idx_offset)
+    c_v0 = _pad_tests(jnp.asarray(m_v0, jnp.uint32), bp) ^ h0
+    c_v1 = _pad_tests(jnp.asarray(m_v1, jnp.uint32), bp) ^ h1
+    p = _lsb(out0)[:, None]
+    cts = jnp.stack([jnp.where(p, c_v1, c_v0), jnp.where(p, c_v0, c_v1)])
+    parts = [
+        gc_pallas._planarize(batch.tables, bp, bp),
+        gc_pallas._planarize(batch.gb_labels, bp, bp),
+        gc_pallas._planarize(jnp.asarray(batch.decode, jnp.uint32), bp, bp),
+        gc_pallas._planarize(jnp.transpose(cts, (1, 0, 2)), bp, bp),
+    ]
+    return jnp.concatenate([jnp.ravel(p_) for p_ in parts]), mask
+
+
+@partial(jax.jit, static_argnames=("S", "n_words"))
+def _eval_equality_payload_packed_xla(msg, ev_labels, S: int,
+                                      n_words: int, idx_offset):
+    from . import gc_pallas
+
+    ev_labels = jnp.asarray(ev_labels, jnp.uint32)
+    B = ev_labels.shape[0]
+    tab, gbl, dec, ctsp = gc_pallas._split_packed(
+        jnp.asarray(msg, jnp.uint32), B, S, n_words
+    )
+    batch = GarbledEqBatch(
+        tables=gc_pallas._unplanarize(tab, B).reshape(B, S - 1, 2, 4),
+        gb_labels=gc_pallas._unplanarize(gbl, B).reshape(B, S, 4),
+        decode=gc_pallas._unplanarize(dec, B).reshape(B) != 0,
+    )
+    cts = gc_pallas._unplanarize(ctsp, B).reshape(B, 2, n_words)
+    cts = jnp.transpose(cts, (1, 0, 2))
+    return _eval_equality_payload_xla(
+        batch, ev_labels, cts, n_words, idx_offset
+    )
+
+
+def garble_equality_payload_packed(R, Y0, seed, x_bits, m_v0, m_v1,
+                                   n_words: int, idx_offset):
+    """Engine dispatcher for the whole-level packed garble (byte-identical
+    planar wire either way).  Returns (msg, mask)."""
+    if jnp.asarray(x_bits).shape[1] >= 2 and _pallas_engine():
+        from . import gc_pallas
+
+        return gc_pallas.garble_equality_payload_packed(
+            R, Y0, seed, x_bits, m_v0, m_v1, n_words, idx_offset
+        )
+    return _garble_equality_payload_packed_xla(
+        R, Y0, seed, x_bits, m_v0, m_v1, n_words, idx_offset
+    )
+
+
+def eval_equality_payload_packed(msg, ev_labels, n_words: int, idx_offset):
+    """Engine dispatcher twin of :func:`garble_equality_payload_packed`.
+    Returns (e bool[B], payload uint32[B, n_words])."""
+    ev_labels = jnp.asarray(ev_labels, jnp.uint32)
+    S = ev_labels.shape[1]
+    if S >= 2 and _pallas_engine():
+        from . import gc_pallas
+
+        return gc_pallas.eval_equality_payload_packed(
+            msg, ev_labels, n_words, idx_offset
+        )
+    return _eval_equality_payload_packed_xla(
+        msg, ev_labels, S, n_words, idx_offset
+    )

@@ -44,6 +44,22 @@ R_BLK = 8  # row-groups per grid step (sweep note: bench.bench_secure_device)
 GROUP = SUB * LANES  # tests per row
 
 
+def padded_tests(B: int) -> int:
+    """Tests per kernel invocation round up to the grid block (R_BLK
+    row-groups of SUB*LANES tests).  The planar WIRE format (the packed
+    whole-level message below) carries this padding — a deterministic
+    function of B, so both endpoints agree on sizes without negotiation."""
+    blk = R_BLK * GROUP
+    return B + (-B) % blk
+
+
+def packed_msg_words(B: int, S: int, W: int) -> int:
+    """u32 words of one packed whole-level garbled message (plane order
+    tables | gb_labels | decode | cts, each plane ``padded_tests(B)``
+    words)."""
+    return ((S - 1) * 8 + 4 * S + 1 + 2 * W) * padded_tests(B)
+
+
 def _sel(bit, a, b):
     """bit ? a : b on u32 vregs (bit is a 0/1 word)."""
     return b ^ ((jnp.uint32(0) - bit) & (a ^ b))
@@ -228,15 +244,17 @@ def _unplanarize(a, B: int):
     return a.reshape(k, -1).T[:B]
 
 
-@partial(jax.jit, static_argnames=("S", "W", "interpret"))
-def _garble_planar(R, Y0, X0, mask, x_bits, m_v0, m_v1, idx_offset,
-                   S: int, W: int, interpret: bool):
+def _garble_call(R, Y0, X0, mask, x_bits, m_v0, m_v1, idx_offset,
+                 S: int, W: int, interpret: bool):
+    """Shared pallas_call builder: planarize inputs, run the garble
+    kernel, return the RAW planar outputs [tables, gb_labels, decode,
+    cts] — the packed wire path ravels them as-is; the compat path
+    unplanarizes back to test-major tensors."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B = x_bits.shape[0]
-    blk_rows = R_BLK * GROUP
-    bp = B + (-B) % blk_rows
+    bp = padded_tests(B)
     rows = bp // GROUP
 
     sc = jnp.concatenate([
@@ -273,6 +291,15 @@ def _garble_planar(R, Y0, X0, mask, x_bits, m_v0, m_v1, idx_offset,
         ],
         interpret=interpret,
     )(sc, *ops)
+    return outs
+
+
+@partial(jax.jit, static_argnames=("S", "W", "interpret"))
+def _garble_planar(R, Y0, X0, mask, x_bits, m_v0, m_v1, idx_offset,
+                   S: int, W: int, interpret: bool):
+    B = x_bits.shape[0]
+    outs = _garble_call(R, Y0, X0, mask, x_bits, m_v0, m_v1, idx_offset,
+                        S, W, interpret)
     tables = _unplanarize(outs[0], B).reshape(B, S - 1, 2, 4)
     gb_labels = _unplanarize(outs[1], B).reshape(B, S, 4)
     decode = _unplanarize(outs[2], B).reshape(B) != 0
@@ -282,31 +309,31 @@ def _garble_planar(R, Y0, X0, mask, x_bits, m_v0, m_v1, idx_offset,
 
 
 @partial(jax.jit, static_argnames=("S", "W", "interpret"))
-def _eval_planar(tables, gb_labels, decode, ev_labels, cts, idx_offset,
-                 S: int, W: int, interpret: bool):
+def _garble_packed(R, Y0, X0, mask, x_bits, m_v0, m_v1, idx_offset,
+                   S: int, W: int, interpret: bool):
+    """Whole-level fused garble→pack: the kernel's planar outputs ravel
+    straight into the wire buffer — no unplanarize transposes, no
+    test-major re-pack; one concatenation is the only copy between the
+    garble kernel and the data-plane fetch."""
+    outs = _garble_call(R, Y0, X0, mask, x_bits, m_v0, m_v1, idx_offset,
+                        S, W, interpret)
+    return jnp.concatenate([jnp.ravel(o) for o in outs])
+
+
+def _eval_call(sc, gbl, evl, tab, dec, cts, S: int, W: int,
+               interpret: bool):
+    """Shared pallas_call builder for the eval kernel: all inputs already
+    planar ``[k, rows, SUB, LANES]``; returns (e planes, payload planes)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    B = gb_labels.shape[0]
-    blk_rows = R_BLK * GROUP
-    bp = B + (-B) % blk_rows
-    rows = bp // GROUP
-
-    sc = jnp.asarray(idx_offset, jnp.uint32).reshape(1)
+    rows = gbl.shape[1]
     n_tab = (S - 1) * 2 * 4
-    ops = [
-        _planarize(gb_labels, B, bp),
-        _planarize(ev_labels, B, bp),
-        _planarize(tables, B, bp),
-        _planarize(jnp.asarray(decode, jnp.uint32), B, bp),
-        _planarize(jnp.transpose(jnp.asarray(cts, jnp.uint32), (1, 0, 2)),
-                   B, bp),
-    ]
     z = np.int32(0)
     spec = lambda k: pl.BlockSpec((k, R_BLK, SUB, LANES),
                                   lambda j: (z, j, z, z))
     sc_spec = pl.BlockSpec((1,), lambda j: (z,), memory_space=pltpu.SMEM)
-    outs = pl.pallas_call(
+    return pl.pallas_call(
         partial(_eval_kernel, S, W),
         grid=(rows // R_BLK,),
         in_specs=[sc_spec,
@@ -318,7 +345,58 @@ def _eval_planar(tables, gb_labels, decode, ev_labels, cts, idx_offset,
             jax.ShapeDtypeStruct((W, rows, SUB, LANES), jnp.uint32),
         ],
         interpret=interpret,
-    )(sc, *ops)
+    )(sc, gbl, evl, tab, dec, cts)
+
+
+@partial(jax.jit, static_argnames=("S", "W", "interpret"))
+def _eval_planar(tables, gb_labels, decode, ev_labels, cts, idx_offset,
+                 S: int, W: int, interpret: bool):
+    B = gb_labels.shape[0]
+    bp = padded_tests(B)
+    sc = jnp.asarray(idx_offset, jnp.uint32).reshape(1)
+    outs = _eval_call(
+        sc,
+        _planarize(gb_labels, B, bp),
+        _planarize(ev_labels, B, bp),
+        _planarize(tables, B, bp),
+        _planarize(jnp.asarray(decode, jnp.uint32), B, bp),
+        _planarize(jnp.transpose(jnp.asarray(cts, jnp.uint32), (1, 0, 2)),
+                   B, bp),
+        S, W, interpret,
+    )
+    e = _unplanarize(outs[0], B).reshape(B) != 0
+    pay = _unplanarize(outs[1], B).reshape(B, W)
+    return e, pay
+
+
+def _split_packed(msg, B: int, S: int, W: int):
+    """Packed wire buffer -> the four planar plane stacks (pure reshapes
+    of contiguous slices — no transposes)."""
+    bp = padded_tests(B)
+    rows = bp // GROUP
+    n_tab = (S - 1) * 2 * 4
+    sizes = [n_tab, 4 * S, 1, 2 * W]
+    parts, base = [], 0
+    for k in sizes:
+        parts.append(msg[base : base + k * bp].reshape(k, rows, SUB, LANES))
+        base += k * bp
+    return parts
+
+
+@partial(jax.jit, static_argnames=("S", "W", "interpret"))
+def _eval_packed(msg, ev_labels, idx_offset, S: int, W: int,
+                 interpret: bool):
+    """Whole-level fused unpack→eval: the wire buffer's planes feed the
+    kernel directly (reshape-slices, no unplanarize) — only the
+    evaluator's OWN labels planarize, once."""
+    B = ev_labels.shape[0]
+    bp = padded_tests(B)
+    tab, gbl, dec, cts = _split_packed(jnp.asarray(msg, jnp.uint32), B, S, W)
+    sc = jnp.asarray(idx_offset, jnp.uint32).reshape(1)
+    outs = _eval_call(
+        sc, gbl, _planarize(ev_labels, B, bp), tab, dec, cts,
+        S, W, interpret,
+    )
     e = _unplanarize(outs[0], B).reshape(B) != 0
     pay = _unplanarize(outs[1], B).reshape(B, W)
     return e, pay
@@ -359,3 +437,36 @@ def eval_equality_payload(batch: gc.GarbledEqBatch, ev_labels, cts,
         jnp.asarray(cts, jnp.uint32),
         idx_offset, S, n_words, interpret,
     )
+
+
+def garble_equality_payload_packed(R, Y0, seed, x_bits, m_v0, m_v1,
+                                   n_words: int, idx_offset,
+                                   interpret: bool = False):
+    """Whole-level garble with the PACKED planar wire output: returns
+    (msg u32[packed_msg_words(B, S, W)], mask bool[B]).  The message is
+    the kernel's plane stack raveled in place — no intermediate label
+    tensor ever re-transposes to test-major layout between garbling and
+    the data-plane fetch.  Byte-identical to the XLA twin
+    (gc._garble_equality_payload_packed_xla)."""
+    x_bits = jnp.asarray(x_bits, bool)
+    B, S = x_bits.shape
+    if S < 2:
+        raise ValueError("gc_pallas requires S >= 2 wire strings")
+    _, (X0,), mask = gc._carve_label_words(seed, B, S, 1, with_r=False)
+    msg = _garble_packed(
+        jnp.asarray(R, jnp.uint32), jnp.asarray(Y0, jnp.uint32), X0, mask,
+        x_bits, jnp.asarray(m_v0, jnp.uint32), jnp.asarray(m_v1, jnp.uint32),
+        idx_offset, S, n_words, interpret,
+    )
+    return msg, mask
+
+
+def eval_equality_payload_packed(msg, ev_labels, n_words: int, idx_offset,
+                                 interpret: bool = False):
+    """Whole-level unpack→eval twin: consumes the packed planar wire
+    buffer directly.  Returns (e bool[B], payload u32[B, n_words])."""
+    ev_labels = jnp.asarray(ev_labels, jnp.uint32)
+    B, S = ev_labels.shape[:2]
+    if S < 2:
+        raise ValueError("gc_pallas requires S >= 2 wire strings")
+    return _eval_packed(msg, ev_labels, idx_offset, S, n_words, interpret)

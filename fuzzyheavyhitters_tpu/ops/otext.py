@@ -111,8 +111,7 @@ def _col_words(seeds: jax.Array, w: int, offset) -> jax.Array:
     return blocks.reshape(128, nb * 16)[:, :w]
 
 
-@partial(jax.jit, static_argnames=("m",))
-def _receiver_extend(seeds0, seeds1, choices, offset, m):
+def _receiver_extend_core(seeds0, seeds1, choices, offset, m):
     w = -(-m // 32)
     t = _col_words(seeds0, w, offset)
     g1 = _col_words(seeds1, w, offset)
@@ -121,12 +120,43 @@ def _receiver_extend(seeds0, seeds1, choices, offset, m):
     return u, _transpose_pack(t, m)
 
 
-@partial(jax.jit, static_argnames=("m",))
-def _sender_extend(seeds, s_bits, u, offset, m):
+def _sender_extend_core(seeds, s_bits, u, offset, m):
     w = -(-m // 32)
     g = _col_words(seeds, w, offset)
     q = g ^ jnp.where(jnp.asarray(s_bits, bool)[:, None], u, jnp.uint32(0))
     return _transpose_pack(q, m)
+
+
+_receiver_extend = partial(jax.jit, static_argnames=("m",))(
+    _receiver_extend_core
+)
+_sender_extend = partial(jax.jit, static_argnames=("m",))(_sender_extend_core)
+
+
+# Fused extension+hash: the column PRG, the u-XOR, the packed butterfly
+# transpose, and the chosen-payload pad hash of one batch as a SINGLE
+# jitted program per role — one device dispatch, no [m, 4] row tensor
+# round-tripping HBM between a separately-dispatched extend and its
+# ot_hash (the three-dispatch shape the per-level b2a flow used to run).
+# The stream offset and pad index base enter as TRACED scalars, so batch
+# N+1 of a session reuses the compiled program — per-batch bookkeeping
+# never recompiles and never syncs the host.
+
+
+@partial(jax.jit, static_argnames=("m", "n_words", "domain"))
+def _receiver_extend_pads(seeds0, seeds1, choices, offset, idx0, m,
+                          n_words, domain):
+    u, t = _receiver_extend_core(seeds0, seeds1, choices, offset, m)
+    return u, t, ot_hash(t, n_words, idx0, domain=domain)
+
+
+@partial(jax.jit, static_argnames=("m", "n_words", "domain"))
+def _sender_extend_pads(seeds, s_bits, s_block, u, offset, idx0, m,
+                        n_words, domain):
+    q = _sender_extend_core(seeds, s_bits, u, offset, m)
+    p0 = ot_hash(q, n_words, idx0, domain=domain)
+    p1 = ot_hash(q ^ s_block[None, :], n_words, idx0, domain=domain)
+    return q, p0, p1
 
 
 @partial(jax.jit, static_argnames=("n_words", "domain"))
@@ -163,10 +193,12 @@ def gf128_double(x: jax.Array) -> jax.Array:
     Blocks are uint32[..., 4] little-endian (bit 0 = lsb of word 0 — the
     :func:`pack_bits` orientation).  One shift-with-carry across the four
     words plus a conditional XOR of the reduction constant 0x87.  Used to
-    combine two Δ-OT rows into one hash input with distinct coefficients
-    (the 1-of-4 chosen-payload OT of protocol/secure.py): the four sender
-    offsets {0, s, 2s, 3s} are pairwise distinct for any s != 0 because
-    doubling is an invertible linear map.
+    combine S Δ-OT rows into one hash input with distinct coefficients
+    (the 1-of-2^S chosen-payload OT of protocol/secure.py): the 2^S
+    sender offsets ``⊕_j c_j·x^j·s`` are pairwise distinct for any
+    s != 0 because the map c -> Σ c_j x^j is injective on polynomials of
+    degree < 128 and multiplication by s is invertible (see
+    :func:`gf128_offsets`).
     """
     x = jnp.asarray(x, jnp.uint32)
     hi = x[..., 3] >> 31  # the outgoing x^127 bit
@@ -174,6 +206,44 @@ def gf128_double(x: jax.Array) -> jax.Array:
         [jnp.zeros_like(x[..., :1]), x[..., :3] >> 31], axis=-1
     )
     return shifted.at[..., 0].set(shifted[..., 0] ^ hi * jnp.uint32(0x87))
+
+
+def gf128_comb(rows: jax.Array) -> jax.Array:
+    """Combine S stacked 128-bit rows with distinct GF(2^128) coefficients:
+    uint32[..., S, 4] -> ``⊕_j x^j · rows[..., j, :]`` as uint32[..., 4].
+
+    Horner form — S-1 doublings total, no 2^S table.  This is the
+    receiver/sender row-combine of the 1-of-2^S chosen-payload OT
+    (protocol/secure.py): for Δ-OT rows ``t_j = q_j ^ y_j·s`` the
+    combination satisfies ``comb(t) = comb(q) ^ o_y`` with ``o_y`` the
+    offset :func:`gf128_offsets` assigns to choice ``y``.
+    """
+    rows = jnp.asarray(rows, jnp.uint32)
+    S = rows.shape[-2]
+    acc = rows[..., S - 1, :]
+    for j in range(S - 2, -1, -1):
+        acc = gf128_double(acc) ^ rows[..., j, :]
+    return acc
+
+
+def gf128_offsets(s_block: jax.Array, S: int) -> jax.Array:
+    """uint32[2^S, 4] — every linear combination ``o_c = ⊕_j c_j·x^j·s``
+    of the doubling ladder of ``s`` (bit j of c, little-endian, picks
+    ``x^j·s``).  Pairwise distinct for any s != 0: ``o_c ^ o_c' =
+    (Σ (c_j ^ c'_j) x^j)·s`` and a nonzero polynomial of degree < 128
+    evaluated at x is a nonzero field element (x's minimal polynomial has
+    degree 128), so the product with an invertible s cannot vanish.
+    Generalizes the 1-of-4 table {0, s, 2s, s^2s} to arbitrary S."""
+    s = jnp.asarray(s_block, jnp.uint32)
+    pows = [s]
+    for _ in range(S - 1):
+        pows.append(gf128_double(pows[-1]))
+    c = jnp.arange(1 << S, dtype=jnp.uint32)
+    offs = jnp.zeros((1 << S, 4), jnp.uint32)
+    for j in range(S):
+        pick = ((c >> j) & 1).astype(bool)[:, None]
+        offs = offs ^ jnp.where(pick, pows[j][None, :], jnp.uint32(0))
+    return offs
 
 
 def s_to_block(s_bits: np.ndarray) -> np.ndarray:
@@ -225,6 +295,22 @@ class OtExtSender:
         p1 = ot_hash(q_rows ^ jnp.asarray(self.s_block), n_words, idx_offset)
         return p0, p1
 
+    def extend_pads(self, m: int, u_msg, n_words: int, domain: int = 0):
+        """:meth:`extend` + :meth:`pads` as ONE jitted program: returns
+        (Q rows uint32[m, 4], pad0, pad1 uint32[m, n_words]).  The pad
+        index base is this batch's pre-extension ``consumed`` counter —
+        the same convention every chosen-payload flow uses — folded in
+        on device, so extension and hash share one dispatch and the
+        rows never surface between them."""
+        q, p0, p1 = _sender_extend_pads(
+            self._seeds, self._s_dev, jnp.asarray(self.s_block),
+            jnp.asarray(u_msg), self._off, self._sent, m, n_words, domain,
+        )
+        w = -(-m // 32)
+        self._off += -(-w // 16)
+        self._sent += m
+        return q, p0, p1
+
 
 class OtExtReceiver:
     """Extension receiver: holds both base-seed columns (it played base-OT
@@ -257,6 +343,22 @@ class OtExtReceiver:
     def pads(self, t_rows: jax.Array, n_words: int, idx_offset: int) -> jax.Array:
         """uint32[m, n_words] — the receiver's chosen pad H(j, T_j)."""
         return ot_hash(t_rows, n_words, idx_offset)
+
+    def extend_pads(self, choices, n_words: int, domain: int = 0):
+        """:meth:`extend` + :meth:`pads` as ONE jitted program: returns
+        (u message, T rows uint32[m, 4], pad uint32[m, n_words]) with the
+        pad index base = this batch's pre-extension ``consumed`` counter
+        (the sender's :meth:`OtExtSender.extend_pads` twin)."""
+        choices = jnp.asarray(choices, bool)
+        m = choices.shape[0]
+        u, t, pad = _receiver_extend_pads(
+            self._seeds0, self._seeds1, choices, self._off, self._recv,
+            m, n_words, domain,
+        )
+        w = -(-m // 32)
+        self._off += -(-w // 16)
+        self._recv += m
+        return u, t, pad
 
 
 def fresh_s_bits(rng: secrets.SystemRandom | None = None) -> np.ndarray:

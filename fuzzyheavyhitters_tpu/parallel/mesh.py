@@ -359,11 +359,12 @@ class MeshRunner:
         label extension, equality + b2a, alive-gated share sums — as a
         single shard_mapped program whose only inter-party traffic is
         ``ppermute`` transfers on the ``servers`` axis: the ICI twin of
-        protocol/rpc.py's socket flow.  1-dim crawls (S = 2) take the
-        1-of-4 chosen-payload-OT fast path — no garbled circuit, TWO
-        transfers per level (u-matrix, payload table); S > 2 runs the
-        GC+OT form with seven (u-matrix, tables/labels/decode, b2a
-        u-matrix, ciphertext pair).  ``garbler`` is static per program
+        protocol/rpc.py's socket flow.  Crawls with S = 2·n_dims ≤
+        secure.OT2S_MAX_S take the 1-of-2^S chosen-payload-OT fast path
+        — no garbled circuit, TWO transfers per level (u-matrix, payload
+        table); wider strings run the GC+OT form with seven (u-matrix,
+        tables/labels/decode, b2a u-matrix, ciphertext pair).
+        ``garbler`` is static per program
         (the perms are trace-time), two compiles per field.
 
         Per-data-shard uniqueness: every (0,j)<->(1,j) chip pair runs its
@@ -419,9 +420,10 @@ class MeshRunner:
             q = otext._sender_extend(sm, s_bits_l, u0, off, m)
             s_block = otext.pack_bits(s_bits_l)
             if secure._ot4_use(S):
-                # 1-of-4 chosen-payload OT: no circuit, the payload table
-                # IS the message — 2 ppermutes per level (u, cts) instead
-                # of the GC path's 7 (see secure.py's S = 2 fast path)
+                # 1-of-2^S chosen-payload OT: no circuit, the payload
+                # table IS the message — 2 ppermutes per level (u, cts)
+                # instead of the GC path's 7 (see secure.py's fast path;
+                # S <= secure.OT2S_MAX_S, i.e. n_dims <= 3)
                 W = secure.payload_words(field)
                 r1, w0, w1 = secure.b2a_payload_pair(field, bseed, B, g)
                 cts_g = secure.ot4_encrypt(
@@ -561,8 +563,8 @@ class MeshRunner:
             shares, self._children = out
         w1 = -(-m // 32)
         if secure._ot4_use(2 * self.n_dims):
-            # S = 2 fast path: one extension (m rows), per-test pads in
-            # their own tweak domain — no second b2a extension
+            # 1-of-2^S fast path: one extension (m rows), per-test pads
+            # in their own tweak domain — no second b2a extension
             sess["blocks"] += -(-w1 // 16)
             sess["sent"] += m
         else:

@@ -164,7 +164,18 @@ class RpcLeader:
                 f_buckets.append(self.cfg.f_max)
         with self.obs.span("warmup"):
             r0, r1 = await self._both(
-                "warmup", {"f_buckets": [int(b) for b in f_buckets]}
+                "warmup",
+                {
+                    "f_buckets": [int(b) for b in f_buckets],
+                    # warm THIS leader's path + span plan, which may
+                    # override the servers' own config (bench legs)
+                    "ot_path": self.cfg.ot_path,
+                    "secure_spans": bool(
+                        self.cfg.secure_exchange
+                        and not self.cfg.secure_whole_level
+                        and self.cfg.crawl_shard_nodes
+                    ),
+                },
             )
         return {"f_buckets": list(f_buckets), "s0": r0, "s1": r1}
 
@@ -185,9 +196,22 @@ class RpcLeader:
         lost span(s), not the level."""
         verb = "tree_crawl_last" if last else "tree_crawl"
         # alternate the garbling server per level (the reference's
-        # gc_sender flip, leader.rs:204-210) to split garbling cost
-        req = {"level": level, "garbler": level % 2}
+        # gc_sender flip, leader.rs:204-210) to split garbling cost; the
+        # equality-test path rides the verb too, so both servers follow
+        # THIS leader's config even when it differs from their own (the
+        # bench's GC-reference leg depends on that)
+        req = {"level": level, "garbler": level % 2,
+               "ot_path": self.cfg.ot_path}
         spans = collect.shard_spans(self._f_bucket, self.cfg.crawl_shard_nodes)
+        if self.cfg.secure_exchange and self.cfg.secure_whole_level:
+            # whole-level secure batching: every (node, client) wire of
+            # the level garbles/evaluates as ONE device program per
+            # f_bucket rung — node-sharding the GC/OT batch into
+            # host-sized chunks (and pipelining those chunks) loses more
+            # to fragmented kernels than the overlap wins.  A mid-level
+            # fault then re-runs the level, not a span
+            # (cfg.secure_whole_level=False restores span granularity).
+            return await self._both(verb, req)
         if len(spans) == 1:
             return await self._both(verb, req)
         depth = max(1, int(getattr(self.cfg, "crawl_pipeline_depth", 1)))

@@ -775,11 +775,29 @@ class CollectorServer:
         self._stash_children(level, shard, children)
         return counts
 
+    async def _phase_sync(self, x) -> None:
+        """Device sync at a secure-kernel phase boundary (OFF the event
+        loop — a bare block_until_ready would starve keepalives exactly
+        like a bare np.asarray).  Gated by ``cfg.secure_phase_sync``: the
+        phases are sequential data-dependent steps, so syncing costs only
+        the dispatch-ahead slack, and buys the phase_otext/garble/eval/
+        b2a spans real device seconds instead of dispatch time."""
+        if self.cfg.secure_phase_sync:
+            await asyncio.to_thread(jax.block_until_ready, x)
+
+    def _zero_phases(self, level: int, *names: str) -> None:
+        """Materialize zero-valued phase timers so the secure-kernel
+        split always carries all four keys on both servers (a garbler
+        has no eval phase, the ot2s path has no garble phase — the run
+        report must show those as 0, not absent)."""
+        for n in names:
+            self.obs.timer_add(n, 0.0, level=level)
+
     async def _crawl_counts_secure(
         self, level: int, count_field, last: bool = False, garbler: int = 0,
-        shard=None,
+        shard=None, ot_path=None,
     ) -> np.ndarray:
-        """The real 2PC data plane (ref: collect.rs:419-501): GC equality +
+        """The real 2PC data plane (ref: collect.rs:419-501): equality +
         OT b2a over the peer socket; returns this server's additive field
         share of every per-(node, pattern) count.  No packed share-bit
         tensor ever crosses the server boundary in this mode.
@@ -789,14 +807,19 @@ class CollectorServer:
         rpc.rs:20-23 — so garbling cost splits across the servers); each
         direction runs its own OT-extension session (``_setup_secure``).
         Every data-plane message is ONE packed array and a level is ONE
-        protocol round trip with exactly one device fetch per message
-        (through a remote-chip tunnel each fetch is a full round trip, so
-        fetch count, not byte count, is the floor): at S = 2 the level is
-        ev u -> 1-of-4 payload table (secure.gb_step_ot4 — no circuit);
-        for S > 2 it is ev u -> gb batch+cts with the b2a payloads riding
-        the garbled batch under the OUTPUT wire labels
-        (secure.gb_step_fused).  (The reference runs GC then a separate
-        OT round here, collect.rs:419-482.)"""
+        protocol round trip with exactly one device fetch per message:
+        ev u -> sender's whole-level planar message — the 1-of-2^S
+        payload table when ``secure.ot_path`` picks "ot2s" (no garbled
+        circuit at all), the packed garbled batch with the b2a payloads
+        riding the OUTPUT wire labels otherwise — built by ONE fused
+        device program per side (secure.gb_step_level/ev_open_level; the
+        reference runs per-core GC then a separate OT round here,
+        collect.rs:419-482).
+
+        The ``gc_ot`` span splits into the secure-kernel phases
+        ``otext`` (extension), ``garble``/``eval`` (circuit work — zero
+        on the ot2s path), and ``b2a`` (payload table / open + field
+        conversion); wire waits are the gc_ot remainder."""
         with self.obs.span("fss", level=level) as sp_fss:
             # dispatch time only: the FSS expansion itself overlaps the
             # exchange below (no sync — a block_until_ready here would
@@ -819,32 +842,69 @@ class CollectorServer:
             self._crawl_ctr += 1
             gc_seed = secure.derive_seed(self._sec_seed, 1, level, self._crawl_ctr)
             b2a_seed = secure.derive_seed(self._sec_seed, 2, level, self._crawl_ctr)
-            ot4 = secure._ot4_use(S)  # S == 2: 1-of-4 OT, no garbled circuit
+            # the leader names the path per verb (like ``garbler``) so
+            # both servers always agree on the wire format even when a
+            # bench/parity leader overrides its own config; absent, the
+            # server's config decides
+            path = secure.ot_path(S, ot_path or self.cfg.ot_path)
+            self.obs.count(f"ot_path_{path}", level=level)
+            W = secure.payload_words(count_field)
             if self.server_id == garbler:  # garbler/sender + OT-ext sender
                 u = await self._dp_recv()
-                if ot4:
-                    msg, vals = secure.gb_step_ot4(
-                        self._ot_snd, u, flat, b2a_seed, count_field, garbler
+                with self.obs.span("otext", level=level):
+                    idx0 = self._ot_snd.consumed
+                    q = self._ot_snd.extend(B * S, u)
+                    await self._phase_sync(q)
+                with self.obs.span("b2a", level=level):
+                    vals, w0, w1 = secure.b2a_payload_pair(
+                        count_field, b2a_seed, B, garbler
                     )
+                    if path == "ot2s":
+                        msg = secure.ot2s_encrypt_packed(
+                            q.reshape(B, S, 4),
+                            jnp.asarray(self._ot_snd.s_block), flat, w1, w0,
+                            W, idx0,
+                        )
+                    await self._phase_sync(w1 if path != "ot2s" else msg)
+                if path == "ot2s":
+                    self._zero_phases(level, "garble", "eval")
                 else:
-                    msg, vals = secure.gb_step_fused(
-                        self._ot_snd, u, flat, gc_seed, b2a_seed, count_field,
-                        garbler,
-                    )
+                    with self.obs.span("garble", level=level):
+                        msg, _ = gc.garble_equality_payload_packed(
+                            jnp.asarray(self._ot_snd.s_block),
+                            q.reshape(B, S, 4), jnp.asarray(gc_seed), flat,
+                            w1, w0, W, idx0,
+                        )
+                        await self._phase_sync(msg)
+                    self._zero_phases(level, "eval")
                 await self._dp_send(await _fetch(msg, self.obs))
             else:  # evaluator + OT receiver (inputs stay on device: each
                 # np.asarray here would cost a full tunnel round trip)
-                u, t_rows, idx0 = secure.ev_step1_fused(self._ot_rcv, flat)
-                await self._dp_send(await _fetch(u, self.obs))
+                with self.obs.span("otext", level=level):
+                    u, t_rows, idx0 = secure.ev_step1_fused(self._ot_rcv, flat)
+                    u_np = await _fetch(u, self.obs)  # forces the extension
+                await self._dp_send(u_np)
                 bmsg = await self._dp_recv()
-                if ot4:
-                    vals = secure.ev_open_ot4(
-                        self._ot_rcv, t_rows, flat, bmsg, B, count_field, idx0
-                    )
+                if path == "ot2s":
+                    with self.obs.span("b2a", level=level):
+                        pay = secure.ot2s_decrypt_packed(
+                            jnp.asarray(t_rows).reshape(B, S, 4), flat,
+                            bmsg, W, idx0,
+                        )
+                        vals = secure.words_to_field(count_field, pay)
+                        await self._phase_sync(vals)
+                    self._zero_phases(level, "garble", "eval")
                 else:
-                    vals = secure.ev_open_fused(
-                        self._ot_rcv, t_rows, bmsg, B, S, count_field, idx0
-                    )
+                    with self.obs.span("eval", level=level):
+                        _, pay = gc.eval_equality_payload_packed(
+                            bmsg, jnp.asarray(t_rows).reshape(B, S, 4), W,
+                            idx0,
+                        )
+                        await self._phase_sync(pay)
+                    with self.obs.span("b2a", level=level):
+                        vals = secure.words_to_field(count_field, pay)
+                        await self._phase_sync(vals)
+                    self._zero_phases(level, "garble")
         with self.obs.span("field", level=level) as sp_field:
             vals = vals.reshape((F_, C, N) + count_field.limb_shape)
             shares = secure.node_share_sums(count_field, vals, jnp.asarray(w))
@@ -886,7 +946,8 @@ class CollectorServer:
         shard = self._parse_shard(req)
         if self.cfg.secure_exchange:
             return await self._crawl_counts_secure(
-                level, FE62, garbler=int(req.get("garbler", 0)), shard=shard
+                level, FE62, garbler=int(req.get("garbler", 0)), shard=shard,
+                ot_path=req.get("ot_path"),
             )
         counts = await self._crawl_counts(level, shard=shard)
         # NB: trusted mode — both servers hold these plaintext counts; the
@@ -912,7 +973,7 @@ class CollectorServer:
         if self.cfg.secure_exchange:
             shares = await self._crawl_counts_secure(
                 level, F255, last=True, garbler=int(req.get("garbler", 0)),
-                shard=shard,
+                shard=shard, ot_path=req.get("ot_path"),
             )
         else:
             counts = await self._crawl_counts(level, last=True, shard=shard)
@@ -1364,18 +1425,35 @@ class CollectorServer:
         buckets = sorted(
             {int(b) for b in (req or {}).get("f_buckets", []) if int(b) > 0}
         )
+        # the requesting leader may name the equality-test path (its own
+        # config's, possibly overriding this server's — the same per-req
+        # override the crawl verbs honor) and ask for span-sized shapes
+        # (a leader that will crawl with secure_whole_level=False)
+        ot_path = (req or {}).get("ot_path") or self.cfg.ot_path
+        want_spans = bool((req or {}).get("secure_spans"))
         L = self.keys.cw_seed.shape[-2]
         shapes = 0
         with self.obs.span("warmup"):
             for b in buckets:
-                sizes = {
-                    hi - lo
-                    for lo, hi in collect.shard_spans(
-                        b, self.cfg.crawl_shard_nodes
-                    )
-                }
+                if (
+                    self.cfg.secure_exchange
+                    and self.cfg.secure_whole_level
+                    and not want_spans
+                ):
+                    # whole-level secure crawls never shard the GC/OT
+                    # batch — warming span-sized programs would compile
+                    # shapes no crawl dispatches (and break the
+                    # warmed-crawl-compiles-nothing contract's economy)
+                    sizes = set()
+                else:
+                    sizes = {
+                        hi - lo
+                        for lo, hi in collect.shard_spans(
+                            b, self.cfg.crawl_shard_nodes
+                        )
+                    }
                 for fb in sorted(sizes | {b}):
-                    self._warm_bucket(fb, L)
+                    self._warm_bucket(fb, L, ot_path)
                     shapes += 1
                     # yield between compiles: each can take seconds, and
                     # the control socket must keep answering keepalives
@@ -1383,7 +1461,7 @@ class CollectorServer:
         self.obs.count("warmup_shapes", shapes)
         return {"shapes": shapes}
 
-    def _warm_bucket(self, fb: int, L: int) -> None:
+    def _warm_bucket(self, fb: int, L: int, ot_path: str | None = None) -> None:
         """Compile (by running on throwaway inputs) every device program
         a crawl at frontier bucket ``fb`` will hit: expand with and
         without children, the trusted count reduction, and in secure
@@ -1399,7 +1477,8 @@ class CollectorServer:
             )
             if self.cfg.secure_exchange:
                 secure.warm_level_kernels(
-                    packed, d, F255 if last else FE62
+                    packed, d, F255 if last else FE62,
+                    path=ot_path or self.cfg.ot_path,
                 )
             else:
                 masks = collect.pattern_masks(d)

@@ -18,13 +18,54 @@ when the knob is unset or this JAX build lacks the config (the crawl
 then simply recompiles as before).  The binaries (bin/leader, bin/server,
 bin/mesh) and bench.py call it at startup; bench additionally defaults
 the knob for its child processes so all sections share one cache.
+
+:func:`backend_compiles` counts fresh XLA backend compiles process-wide
+(via jax.monitoring) — the acceptance instrument for warmup coverage:
+a crawl over warmed shapes must add ZERO to it.
 """
 
 from __future__ import annotations
 
 import os
+import threading
 
 _enabled: str | None = None
+
+# fresh-compile accounting: every backend compile (an XLA compile that
+# was NOT served from any cache — the thing warmup exists to take off
+# the measured clock) bumps this counter via jax.monitoring.  Tests pin
+# the warmed-crawl contract with it: a crawl on warmed shapes must
+# report a delta of ZERO (a per-batch static arg, a fresh jit wrapper
+# per call, or a warmup coverage hole all break that loudly).
+_compile_lock = threading.Lock()
+_compile_count = 0
+_listener_on = False
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _on_event_duration(name: str, *_a, **_k) -> None:
+    global _compile_count
+    if name == _COMPILE_EVENT:
+        with _compile_lock:
+            _compile_count += 1
+
+
+def backend_compiles() -> int:
+    """Process-wide count of fresh XLA backend compiles so far.  The
+    jax.monitoring listener registers on first use (and stays for the
+    process lifetime — listeners cannot unregister portably); snapshot
+    before and after the measured region and compare deltas."""
+    global _listener_on
+    with _compile_lock:
+        if not _listener_on:
+            import jax
+
+            jax.monitoring.register_event_duration_secs_listener(
+                _on_event_duration
+            )
+            _listener_on = True
+        return _compile_count
 
 
 def enable(path: str | None = None) -> str | None:

@@ -73,6 +73,26 @@ class Config:
     # (1 = sequential; >1 overlaps span k's plane I/O with span k+1's
     # device expand — protocol/leader_rpc.py pipelined crawl)
     crawl_pipeline_depth: int = 1
+    # equality-test engine (protocol/secure.ot_path): "auto" runs the
+    # 1-of-2^S chosen-payload OT (no garbled circuit) whenever the
+    # string width S = 2·n_dims fits secure.OT2S_MAX_S, the garbled
+    # circuit beyond; "ot2s"/"gc" force one path (both servers derive
+    # the path from this knob + S, so the wire format always agrees)
+    ot_path: str = "auto"
+    # secure crawls garble/evaluate each level as ONE whole-level device
+    # program (ignoring crawl_shard_nodes for the GC/OT batch) — the
+    # device-resident batching that closes the trusted/secure gap.  Set
+    # False to restore node-sharded secure levels (mid-level retry at
+    # span granularity, span pipelining) at the cost of fragmenting the
+    # equality batch into host-sized chunks.
+    secure_whole_level: bool = True
+    # per-level secure-kernel phase split (phase_otext/garble/eval/b2a
+    # spans in the run report): True syncs the device at each phase
+    # boundary so the spans carry real device time — the acceptance
+    # instrument for kernel work.  False skips the syncs (spans then
+    # measure dispatch only); the phases are sequential data-dependent
+    # steps, so the syncs cost only the dispatch-ahead slack.
+    secure_phase_sync: bool = True
 
 
 def load_config(path: str) -> Config:

@@ -46,10 +46,18 @@ sc = doc.get("extra", {}).get("secure_crawl", {})
 assert "secure_clients_per_sec" in sc, (
     "secure_crawl section missing from the compact line: " + last[:300]
 )
+sk = sc.get("secure_kernel", {})
+assert "ot_path" in sk and all(
+    f"phase_{p}_seconds" in sk for p in ("otext", "garble", "eval", "b2a")
+), (
+    "secure_kernel phase split (phase_otext/garble/eval/b2a + ot_path) "
+    "missing from the compact line: " + last[:300]
+)
 print(
     "bench_smoke OK: "
     f"{doc['metric']}={doc['value']}, "
     f"secure_clients_per_sec={sc['secure_clients_per_sec']}, "
+    f"ot_path={sk['ot_path']}, "
     f"pipeline_speedup={sc.get('pipeline_speedup')}, "
     f"line={len(last)}B, elapsed={doc.get('budget', {}).get('elapsed_s')}s"
 )

@@ -57,4 +57,28 @@ print(
 )
 EOF
 rm -f "$report"
+
+# bench output contract (part of the same CI gate): a budget or
+# final-JSON-line regression — the rc=124/empty-tail failure mode — must
+# fail HERE, not in the next harness round.  Skippable for a quick
+# chaos-only loop with FHH_SKIP_BENCH_SMOKE=1.
+if [ "${FHH_SKIP_BENCH_SMOKE:-0}" != "1" ]; then
+    if scripts/bench_smoke.sh; then
+        python - "$artifact" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+doc["bench_smoke"] = "passed"
+json.dump(doc, open(sys.argv[1], "w"), indent=1)
+EOF
+    else
+        echo "chaos suite: bench_smoke FAILED" >&2
+        python - "$artifact" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+doc["bench_smoke"] = "failed"
+json.dump(doc, open(sys.argv[1], "w"), indent=1)
+EOF
+        rc=1
+    fi
+fi
 exit $rc

@@ -676,6 +676,87 @@ def test_self_lint_repo_clean_under_baseline():
     )
 
 
+# ---------------------------------------------------------------------------
+# rule: chunked-device-readback
+# ---------------------------------------------------------------------------
+
+_READBACK_SCOPE = "fuzzyheavyhitters_tpu/protocol/secure.py"
+
+
+def test_chunked_readback_loop_fetches_detected():
+    """Every readback form — the sanctioned ``_fetch`` included — trips
+    the rule when it sits inside a per-chunk loop in a readback module:
+    a loop of fetches is one device round trip per chunk no matter how
+    each individual fetch is dressed."""
+    src = """
+    import numpy as np
+    import jax
+
+    async def crawl(chunks, reg):
+        out = []
+        for c in chunks:
+            out.append(await _fetch(c, reg))
+        for c in chunks:
+            out.append(np.asarray(c))
+        for c in chunks:
+            out.append(jax.device_get(c))
+        for c in chunks:
+            c.copy_to_host_async()
+        return out
+    """
+    found = _lint(src, _READBACK_SCOPE, rule="chunked-device-readback")
+    assert len(found) == 4
+    assert all(f.rule == "chunked-device-readback" for f in found)
+
+
+def test_chunked_readback_whole_level_fetch_clean():
+    """The sanctioned shape — stack on device inside the loop, ONE fetch
+    after it — is clean, as are readbacks outside any loop."""
+    src = """
+    import numpy as np
+
+    async def crawl(chunks, reg):
+        parts = []
+        for c in chunks:
+            parts.append(transform(c))  # device-side, no readback
+        whole = await _fetch(stack(parts), reg)
+        direct = np.asarray(whole)
+        return whole, direct
+    """
+    assert _lint(src, _READBACK_SCOPE, rule="chunked-device-readback") == []
+
+
+def test_chunked_readback_scoped_to_readback_modules():
+    src = """
+    async def f(chunks):
+        return [await _fetch(c) for c in chunks]
+    """
+    # comprehensions are loops too
+    assert _lint(src, _READBACK_SCOPE, rule="chunked-device-readback")
+    assert _lint(
+        src, "fuzzyheavyhitters_tpu/ops/fake.py",
+        rule="chunked-device-readback",
+    )
+    # rpc.py is deliberately OUT of scope: its per-batch wire fetches
+    # (sketch_verify) carry host-sync suppressions with justifications
+    assert _lint(
+        src, "fuzzyheavyhitters_tpu/protocol/rpc.py",
+        rule="chunked-device-readback",
+    ) == []
+    assert _lint(src, "tests/test_x.py", rule="chunked-device-readback") == []
+
+
+def test_chunked_readback_device_side_asarray_clean():
+    """jnp.asarray is a device-side cast, not a readback — must not trip."""
+    src = """
+    import jax.numpy as jnp
+
+    def f(chunks):
+        return [jnp.asarray(c) for c in chunks]
+    """
+    assert _lint(src, _READBACK_SCOPE, rule="chunked-device-readback") == []
+
+
 def test_every_rule_has_fixture_coverage():
     """Each shipped rule appears in at least one positive fixture above —
     guards against a rule being added but never exercised."""
@@ -686,6 +767,7 @@ def test_every_rule_has_fixture_coverage():
         "unguarded-shared-state",
         "broad-except",
         "bare-print",
+        "chunked-device-readback",
         "unbounded-await",
     }
     assert {r.name for r in ALL_RULES} == covered

@@ -77,12 +77,20 @@ def test_mesh_two_devices(client_batch, colocated_result, cpu_devices):
     assert got == colocated_result
 
 
-def test_mesh_secure_matches_trusted(client_batch, colocated_result, cpu_devices):
+def test_mesh_secure_matches_trusted(
+    client_batch, colocated_result, cpu_devices, monkeypatch
+):
     """The GC+OT 2PC on the 2×4 mesh (four ppermute transfers per level on
     the servers axis, FE62 inner levels + F255 last level) reconstructs the
     exact trusted-mode heavy hitters.  Same scenario as the trusted parity
     test, so the oracle and the trusted kernel family compile once for the
-    module."""
+    module.  EQ_OT4 is forced OFF: at this n_dims=2 shape the default
+    engine is now the 1-of-2^S table (covered by the ot4 test below and
+    the socket suite), and THIS test is what keeps the mesh GC branch —
+    the required path for S > secure.OT2S_MAX_S — exercised."""
+    from fuzzyheavyhitters_tpu.protocol import secure
+
+    monkeypatch.setattr(secure, "EQ_OT4", False)
     _, k0, k1, _, _, n = client_batch
     assert colocated_result
 

@@ -128,7 +128,10 @@ def test_pipelined_matches_sequential_bit_identical(rng, mode):
     sk0 = sk1 = None
     kw = {}
     if mode == "secure":
-        kw["secure_exchange"] = True
+        # secure_whole_level=False: this test exercises the SHARDED
+        # secure pipeline (the whole-level default collapses a secure
+        # level to one span — covered by test_secure_kernels.py)
+        kw.update(secure_exchange=True, secure_whole_level=False)
     if mode == "sketch":
         kw.update(malicious=True, threshold=0.5, addkey_batch_size=12)
         seeds = rng.integers(0, 2**32, size=(n, 2, 4), dtype=np.uint32)
@@ -328,6 +331,14 @@ def test_bench_budget_and_compact_line(monkeypatch):
         "secure_crawl": {
             "secure_clients_per_sec": 112.5,
             "ms_per_level_e2e": 750.0,
+            "secure_kernel": {
+                "ot_path": "ot2s",
+                "phase_otext_seconds": 0.4,
+                "phase_garble_seconds": 0.0,
+                "phase_eval_seconds": 0.0,
+                "phase_b2a_seconds": 0.9,
+            },
+            "whole_level_speedup_vs_pipelined": 3.2,
             "sequential_clients_per_sec": 56.0,
             "pipeline_speedup": 2.01,
             "pipeline": {"depth": 4, "overlap_seconds": 9.1, "stalls": 0},
@@ -341,7 +352,10 @@ def test_bench_budget_and_compact_line(monkeypatch):
     compact = bench._compact_extra(extra)
     assert "keygen_sweep" not in compact
     assert compact["secure_crawl"]["secure_clients_per_sec"] == 112.5
-    assert compact["secure_crawl"]["pipeline"]["depth"] == 4
+    assert compact["secure_crawl"]["secure_kernel"]["ot_path"] == "ot2s"
+    assert compact["secure_crawl"]["whole_level_speedup_vs_pipelined"] == 3.2
+    # the bulky blocks stay out of the compact line
+    assert "pipeline" not in compact["secure_crawl"]
     assert "hitters" not in compact["secure_crawl"]
     assert compact["crawl_hbm_max"] == {"skipped": "budget"}
     assert compact["covid"] == {"error": "timeout after 540s"}
