@@ -1209,6 +1209,126 @@ def bench_upload(n=1_000_000, L=16, batch=4000, port=39731):
     }
 
 
+def bench_ingest(n=65536, L=12, chunk=256, port=39931, threshold=0.05):
+    """Streaming front-door benchmark (ROADMAP "Streaming ingestion",
+    ≥ 100k keys/sec acceptance): clients submit key chunks continuously
+    through the admission-controlled ``submit_keys`` verb into tumbling
+    windows; window 0 is sealed and crawled while window 1 keeps
+    ingesting CONCURRENTLY (``submit_keys`` bypasses the servers' verb
+    lock).  Reports the sustained admission rate for both phases — pure
+    ingest and ingest-during-crawl — plus the windowed crawl seconds,
+    and asserts the windowed window-0 result BIT-IDENTICAL to a batch
+    (``upload_keys`` + ``run``) crawl over the same admitted key set
+    before reporting anything.  Host-side ingest: keys stream as numpy
+    buffers; the device sees them once at each ``window_load``."""
+    import asyncio
+
+    from fuzzyheavyhitters_tpu.obs import report as obsreport
+    from fuzzyheavyhitters_tpu.ops import ibdcf
+    from fuzzyheavyhitters_tpu.protocol.leader_rpc import WindowedIngest
+    from fuzzyheavyhitters_tpu.utils.config import Config
+
+    rng = np.random.default_rng(5)
+    sites = rng.integers(0, 1 << L, size=8)
+    pts = sites[rng.integers(0, 8, size=n)]
+    pts_bits = (
+        ((pts[:, None, None] >> np.arange(L - 1, -1, -1)) & 1) > 0
+    )  # [n, 1, L] MSB-first
+    # host NumPy keygen on purpose (like bench_upload): ingest is a
+    # control-plane path and the chunks must be host-contiguous buffers
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 1, rng, engine="np")
+
+    def mkcfg(p):
+        return Config(
+            data_len=L, n_dims=1, ball_size=1, addkey_batch_size=1024,
+            num_sites=8, threshold=threshold, zipf_exponent=1.03,
+            server0=f"127.0.0.1:{p}", server1=f"127.0.0.1:{p + 10}",
+            distribution="zipf", f_max=64,
+            ingest_window_keys=max(n, 1 << 20),
+        )
+
+    half = n // 2
+
+    def chunks(lo, hi):
+        for i, c0_lo in enumerate(range(lo, hi, chunk)):
+            sl = slice(c0_lo, min(c0_lo + chunk, hi))
+            yield (
+                f"site{i % 8}",
+                tuple(np.asarray(x)[sl] for x in k0),
+                tuple(np.asarray(x)[sl] for x in k1),
+            )
+
+    out = {}
+
+    async def run():
+        lead, c0, c1, s0, s1 = await _bring_up_pair(mkcfg(port), port)
+        wi = WindowedIngest(lead, checkpoint=False)
+        # window 0: pure ingest throughput
+        t0 = time.perf_counter()
+        for cid, a, b in chunks(0, half):
+            await wi.submit(cid, a, b)
+        dt_ingest = time.perf_counter() - t0
+        stats0 = await wi.seal_window()
+        # window 1 ingests WHILE window 0's crawl runs
+        async def pump():
+            t = time.perf_counter()
+            for cid, a, b in chunks(half, n):
+                await wi.submit(cid, a, b)
+            return time.perf_counter() - t
+
+        t_crawl = time.perf_counter()
+        crawl_task = asyncio.create_task(wi.crawl_window(0))
+        dt_concurrent = await pump()
+        res0 = await crawl_task
+        dt_crawl = time.perf_counter() - t_crawl
+        stats1 = await wi.seal_window()
+        rep = obsreport.run_report([wi.obs])
+        ing = rep.get("ingest") or {}
+        out["ingest_keys_per_sec"] = round(half / dt_ingest, 1)
+        out["concurrent_keys_per_sec"] = round((n - half) / dt_concurrent, 1)
+        out["window_crawl_seconds"] = round(dt_crawl, 3)
+        out["windows"] = int(ing.get("windows", 2))
+        out["admitted"] = int(ing.get("admitted", 0))
+        out["shed"] = int(stats0["shed_keys"]) + int(stats1["shed_keys"])
+        out["rejected"] = int(ing.get("rejected", 0))
+        out["n_keys"] = n
+        out["chunk_keys"] = chunk
+        out["report_ingest"] = ing
+        for c in (c0, c1):
+            await c.aclose()
+        for s in (s0, s1):
+            await s.aclose()
+        return res0
+
+    async def batch():
+        from fuzzyheavyhitters_tpu.ops.ibdcf import IbDcfKeyBatch
+
+        lead, c0, c1, s0, s1 = await _bring_up_pair(mkcfg(port + 40), port + 40)
+        bk0 = IbDcfKeyBatch(*(np.asarray(x)[:half] for x in k0))
+        bk1 = IbDcfKeyBatch(*(np.asarray(x)[:half] for x in k1))
+        await lead.upload_keys(bk0, bk1)
+        res = await lead.run(half)
+        for c in (c0, c1):
+            await c.aclose()
+        for s in (s0, s1):
+            await s.aclose()
+        return res
+
+    res_windowed = asyncio.run(run())
+    res_batch = asyncio.run(batch())
+    # the number is only reported once the windowed path EARNED it
+    if not (
+        np.array_equal(res_windowed.counts, res_batch.counts)
+        and np.array_equal(res_windowed.paths, res_batch.paths)
+    ):
+        raise AssertionError(
+            "windowed window-0 crawl diverged from the batch crawl over "
+            "the same admitted keys"
+        )
+    out["bit_identical_vs_batch"] = True
+    return out
+
+
 # sections of the run that already finished, keyed by metric name — what
 # the SIGTERM handler dumps so a timed-out bench still reports them
 _PARTIAL: dict = {}
@@ -1429,6 +1549,10 @@ _COMPACT_KEYS = {
     "covid": ("covid_clients_per_sec",),
     "hash_margin": ("garble_ms_rounds_8",),
     "upload": ("upload_keys_per_sec",),
+    "ingest": (
+        "ingest_keys_per_sec", "concurrent_keys_per_sec", "windows",
+        "shed", "rejected", "bit_identical_vs_batch",
+    ),
 }
 
 
@@ -1545,6 +1669,17 @@ def main():
         "import json, bench;print(json.dumps(bench.bench_upload()))",
         timeout_s=540,
     )
+    ingest = section(
+        "ingest",
+        "import json, bench;print(json.dumps(bench.bench_ingest()))",
+        timeout_s=540,
+        # smoke: tiny window pair, still concurrent + bit-identity-gated
+        smoke_code=(
+            "import json, bench;"
+            "print(json.dumps(bench.bench_ingest(n=512, L=6, chunk=32,"
+            " threshold=0.2)))"
+        ),
+    )
     crawl_hbm_max = section(
         "crawl_hbm_max",
         "import json, numpy as np, bench;"
@@ -1570,6 +1705,7 @@ def main():
         "covid": covid,
         "hash_margin": hash_margin,
         "upload": upload,
+        "ingest": ingest,
     }
     head = {
         "metric": "ibdcf_keygen_keys_per_sec_at_data_len_512",

@@ -94,6 +94,14 @@ class LintConfig:
         "fuzzyheavyhitters_tpu/protocol/secure.py",
         "fuzzyheavyhitters_tpu/ops",
     )
+    # unbounded-queue rule: ingest/transport modules where every
+    # producer/consumer buffer (asyncio.Queue, deque) must carry a
+    # maxsize/maxlen bound — the overload-never-OOMs invariant of the
+    # streaming front door
+    queue_modules: tuple = (
+        "fuzzyheavyhitters_tpu/protocol",
+        "fuzzyheavyhitters_tpu/resilience",
+    )
     severity_overrides: dict = field(default_factory=dict)
     baseline: str = "lint_baseline.json"
     default_paths: tuple = ("fuzzyheavyhitters_tpu", "tests")
@@ -214,6 +222,7 @@ def load_config(root: str | None = None, pyproject: str | None = None) -> LintCo
         "shared_state_modules",
         "await_modules",
         "readback_modules",
+        "queue_modules",
         "default_paths",
     ):
         val = section.get(key)

@@ -1,6 +1,6 @@
 """The fhh-lint rule set, tuned to this codebase's invariants.
 
-Eight rules over seven concerns (the broad-except/bare-print concern
+Nine rules over eight concerns (the broad-except/bare-print concern
 ships as two rules so suppressions and severities stay per-rule):
 
 - ``host-sync-in-hot-loop`` — device->host synchronization primitives
@@ -51,6 +51,14 @@ ships as two rules so suppressions and severities stay per-rule):
   by a kwarg timeout, ``asyncio.wait_for``, or a ``Deadline`` — and the
   deliberately-unbounded sites (serve loops waiting for the next
   command) carry inline suppressions with justifications.
+- ``unbounded-queue`` — ``asyncio.Queue()``/``queue.Queue()`` without a
+  positive ``maxsize`` and ``collections.deque()`` without a ``maxlen``
+  in the configured ingest/transport modules (``queue_modules``:
+  protocol + resilience).  An unbounded buffer between a fast producer
+  (a flooding client) and a slow consumer (the crawl) converts overload
+  into OOM — the exact failure class the admission-controlled front
+  door exists to prevent; every buffer is bounded or carries an inline
+  suppression proving it is bounded by construction.
 """
 
 from __future__ import annotations
@@ -779,6 +787,79 @@ class UnboundedAwait(Rule):
         return not (isinstance(t, ast.Constant) and t.value is None)
 
 
+# ---------------------------------------------------------------------------
+# 9. unbounded-queue
+# ---------------------------------------------------------------------------
+
+# buffer constructors and the kwarg that bounds each.  SimpleQueue has no
+# bound AT ALL, so its mere construction is the finding.
+_QUEUE_CTORS = {
+    "Queue": "maxsize",
+    "LifoQueue": "maxsize",
+    "PriorityQueue": "maxsize",
+    "deque": "maxlen",
+}
+
+
+class UnboundedQueue(Rule):
+    """Unbounded producer/consumer buffers in the ingest/transport
+    modules (``queue_modules``).  ``asyncio.Queue()`` with no (or zero)
+    ``maxsize`` and ``deque()`` with no ``maxlen`` grow without limit
+    when the producer outruns the consumer — under a client flood that
+    is an OOM, not backpressure.  The front door's contract is bounded
+    pools + explicit shed/reject verdicts; a buffer that is provably
+    bounded by construction carries an inline suppression saying why."""
+
+    name = "unbounded-queue"
+    default_severity = "error"
+
+    def check(self, mod: SourceModule, cfg):
+        if not _under_prefix(mod.relpath, cfg.queue_modules):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            seg = last_segment(dotted_name(node.func))
+            if seg == "SimpleQueue":
+                yield (
+                    *_span(node),
+                    "SimpleQueue has no maxsize at all — use Queue with "
+                    "a positive maxsize (bounded buffers or explicit "
+                    "shed, never silent growth)",
+                )
+                continue
+            bound_kw = _QUEUE_CTORS.get(seg)
+            if bound_kw is None:
+                continue
+            bound = self._bound_arg(node, seg, bound_kw)
+            if bound is None:
+                yield (
+                    *_span(node),
+                    f"{seg}() constructed without a {bound_kw} bound — "
+                    "an overloaded producer grows it without limit "
+                    f"(pass a positive {bound_kw}, or suppress with a "
+                    "justification if it is bounded by construction)",
+                )
+            elif isinstance(bound, ast.Constant) and bound.value in (0, None):
+                yield (
+                    *_span(node),
+                    f"{seg}({bound_kw}={bound.value!r}) is unbounded in "
+                    f"disguise — pass a positive {bound_kw}",
+                )
+
+    @staticmethod
+    def _bound_arg(call: ast.Call, seg: str, bound_kw: str):
+        """The expression bounding this constructor, or None.  Queue's
+        maxsize is its first positional; deque's maxlen is its second."""
+        for kw in call.keywords:
+            if kw.arg == bound_kw:
+                return kw.value
+        pos = 0 if bound_kw == "maxsize" else 1
+        if len(call.args) > pos:
+            return call.args[pos]
+        return None
+
+
 ALL_RULES: tuple[Rule, ...] = (
     HostSyncInHotLoop(),
     SecretToSink(),
@@ -788,6 +869,7 @@ ALL_RULES: tuple[Rule, ...] = (
     BarePrint(),
     ChunkedDeviceReadback(),
     UnboundedAwait(),
+    UnboundedQueue(),
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

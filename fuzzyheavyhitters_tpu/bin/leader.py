@@ -38,6 +38,15 @@ def _split(addr: str) -> tuple[str, int]:
     return host, int(port)
 
 
+async def _emit_window(res, window: int) -> None:
+    """Per-window heavy-hitter lines for the streaming (FHH_WINDOWS)
+    mode — each tumbling window reports its own hitter set."""
+    for row, c in zip(res.decode_ints(), res.counts):
+        obs.emit(
+            "hitter", window=window, value=str(row.tolist()), count=int(c)
+        )
+
+
 def keygen_report(cfg, rng, engine: str) -> None:
     """Key-size / keys-per-second report (ref: leader.rs:90-104, 319-329).
 
@@ -140,6 +149,43 @@ async def _run(cfg, nreqs: int, rng) -> None:
             seconds=round(time.perf_counter() - t_w, 2),
             f_buckets=info["f_buckets"],
         )
+
+    # streaming-ingest mode (FHH_WINDOWS=N, N > 1): instead of one bulk
+    # upload, the fabricated clients submit their key shares continuously
+    # through the admission-controlled front door (submit_keys) in N
+    # tumbling windows; each window seals at its boundary and its frozen
+    # snapshot is crawled while the next window keeps ingesting.  The
+    # production shape on the ROADMAP, driven here from one process.
+    windows = max(1, int(os.environ.get("FHH_WINDOWS", "1")))
+    if windows > 1 and sk0 is None:
+        from ..protocol.leader_rpc import WindowedIngest
+
+        t0 = time.perf_counter()
+        await asyncio.gather(c0.call("reset"), c1.call("reset"))
+        wi = WindowedIngest(lead)
+        per_w = (nreqs + windows - 1) // windows
+        bs = max(1, cfg.addkey_batch_size)
+        crawl_task = None
+        for w in range(windows):
+            lo_w, hi_w = w * per_w, min((w + 1) * per_w, nreqs)
+            for chunk_no, lo in enumerate(range(lo_w, hi_w, bs)):
+                sl = slice(lo, min(lo + bs, hi_w))
+                await wi.submit(
+                    f"site{chunk_no % max(1, cfg.num_sites)}",
+                    tuple(np.asarray(x)[sl] for x in k0),
+                    tuple(np.asarray(x)[sl] for x in k1),
+                )
+            stats = await wi.seal_window()
+            if crawl_task is not None:
+                await _emit_window(await crawl_task, w - 1)
+            if stats["keys"]:
+                crawl_task = asyncio.create_task(wi.crawl_window(w))
+            else:
+                crawl_task = None
+        if crawl_task is not None:
+            await _emit_window(await crawl_task, windows - 1)
+        obs.emit("crawl.done", seconds=round(time.perf_counter() - t0, 2))
+        return
 
     # supervised crawl (FHH_SUPERVISE=0 opts out), malicious mode
     # included — the per-level challenge ratchet makes sketch crawls

@@ -63,8 +63,27 @@ def _load():
                 np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
                 np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
             ]
+            lib.reservoir_new.restype = ctypes.c_void_p
+            lib.reservoir_new.argtypes = [ctypes.c_long, ctypes.c_ulonglong]
+            lib.reservoir_free.argtypes = [ctypes.c_void_p]
+            lib.reservoir_offer.restype = ctypes.c_long
+            lib.reservoir_offer.argtypes = [
+                ctypes.c_void_p, ctypes.c_long,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            ]
+            lib.reservoir_state.argtypes = [
+                ctypes.c_void_p,
+                np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
+            ]
+            lib.reservoir_from_state.restype = ctypes.c_void_p
+            lib.reservoir_from_state.argtypes = [
+                np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
+            ]
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a stale .so from before the incremental
+            # reservoir ABI — treat like no native library at all (the
+            # pure-Python twin below is bit-identical)
             _build_failed = True
     return _lib
 
@@ -91,3 +110,134 @@ def csv_reservoir_sample(
     if kept < 0:
         raise FileNotFoundError(path)
     return np.stack([out_a[:kept], out_b[:kept]], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Incremental in-memory reservoir (the ingest front door's shed mode)
+# ---------------------------------------------------------------------------
+
+_U64 = (1 << 64) - 1
+
+
+class _PyXoshiro256:
+    """Pure-Python twin of reservoir.cc's Xoshiro256 (splitmix64 seeding,
+    xoshiro256**, Lemire unbiased bounding) — BIT-IDENTICAL by
+    construction, so a reservoir sampled without the native library (or
+    restored on a host without g++) makes the same slot decisions."""
+
+    __slots__ = ("s",)
+
+    def __init__(self, seed: int):
+        x = seed & _U64
+        s = []
+        for _ in range(4):
+            x = (x + 0x9E3779B97F4A7C15) & _U64
+            z = x
+            z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _U64
+            z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _U64
+            s.append(z ^ (z >> 31))
+        self.s = s
+
+    @staticmethod
+    def _rotl(v: int, k: int) -> int:
+        return ((v << k) | (v >> (64 - k))) & _U64
+
+    def next(self) -> int:
+        s = self.s
+        result = (self._rotl((s[1] * 5) & _U64, 7) * 9) & _U64
+        t = (s[1] << 17) & _U64
+        s[2] ^= s[0]
+        s[3] ^= s[1]
+        s[1] ^= s[2]
+        s[0] ^= s[3]
+        s[2] ^= t
+        s[3] = self._rotl(s[3], 45)
+        return result
+
+    def below(self, n: int) -> int:
+        m = self.next() * n
+        lo = m & _U64
+        if lo < n:
+            floor = ((_U64 + 1) - n) % n
+            while lo < floor:
+                m = self.next() * n
+                lo = m & _U64
+        return m >> 64
+
+
+class Reservoir:
+    """Seeded algorithm-R reservoir over CALLER-OWNED slots: ``offer(n)``
+    returns each sequential item's slot in ``[0, k)`` (replace the
+    occupant) or ``-1`` (shed the item).  Runs on the native library when
+    available, the bit-identical Python twin otherwise; ``state()`` /
+    ``from_state()`` round-trip the full sampling stream so a restored
+    server continues the SAME (seed-reproducible) shed sequence."""
+
+    def __init__(self, k: int, seed: int, *, _handle=None, _py=None,
+                 _seen: int = 0):
+        if k <= 0:
+            raise ValueError("reservoir capacity must be positive")
+        self.k = int(k)
+        self._lib = _load()
+        if _handle is not None or _py is not None:
+            self._handle, self._py, self._seen = _handle, _py, _seen
+            return
+        if self._lib is not None:
+            self._handle = self._lib.reservoir_new(self.k, seed & _U64)
+            self._py = None
+        else:
+            self._handle = None
+            self._py = _PyXoshiro256(seed)
+        self._seen = 0
+
+    @property
+    def seen(self) -> int:
+        return self._seen
+
+    def offer(self, n: int = 1) -> np.ndarray:
+        """Slots for the next ``n`` sequential items (int64[n]; -1 = shed)."""
+        if n <= 0:
+            return np.zeros(0, np.int64)
+        if self._handle is not None:
+            out = np.empty(n, np.int64)
+            self._lib.reservoir_offer(self._handle, n, out)
+            self._seen += n
+            return out
+        out = np.empty(n, np.int64)
+        for i in range(n):
+            if self._seen < self.k:
+                out[i] = self._seen
+            else:
+                j = self._py.below(self._seen + 1)
+                out[i] = j if j < self.k else -1
+            self._seen += 1
+        return out
+
+    def state(self) -> np.ndarray:
+        """uint64[6]: [k, seen, s0..s3] — checkpointable."""
+        if self._handle is not None:
+            out = np.empty(6, np.uint64)
+            self._lib.reservoir_state(self._handle, out)
+            return out
+        return np.array(
+            [self.k, self._seen] + list(self._py.s), np.uint64
+        )
+
+    @classmethod
+    def from_state(cls, st) -> "Reservoir":
+        st = np.ascontiguousarray(np.asarray(st, np.uint64))
+        if st.shape != (6,):
+            raise ValueError("reservoir state must be uint64[6]")
+        k, seen = int(st[0]), int(st[1])
+        lib = _load()
+        if lib is not None:
+            handle = lib.reservoir_from_state(st)
+            return cls(k, 0, _handle=handle, _seen=seen)
+        py = _PyXoshiro256(0)
+        py.s = [int(v) for v in st[2:]]
+        return cls(k, 0, _py=py, _seen=seen)
+
+    def __del__(self):
+        lib, handle = getattr(self, "_lib", None), getattr(self, "_handle", None)
+        if lib is not None and handle is not None:
+            lib.reservoir_free(handle)

@@ -1,4 +1,5 @@
-// Streaming CSV reservoir sampler — the native data-loader core.
+// Streaming reservoir sampler — the native data-loader core, plus the
+// incremental in-memory reservoir the ingest front door sheds into.
 //
 // The reference's samplers are native Rust over multi-GB CSVs: a memmap
 // re-read with row indexing (src/sample_covid_data.rs:75-135) and a seeded
@@ -11,6 +12,18 @@
 //
 //   long csv_reservoir_sample(path, col_a, col_b, k, seed, out_a, out_b)
 //     -> number of rows sampled (<= k), or -1 on open failure.
+//
+//   // incremental reservoir (resilience/admission.py's shed mode): the
+//   // caller owns the slot table of payloads, the reservoir only decides
+//   // slot placement — offer n items, get back each item's slot in
+//   // [0, k) (replace the occupant) or -1 (shed this item).  State is
+//   // fully extractable/restorable so a recovering server resumes the
+//   // SAME sampling stream (checkpoint-carried, seed-reproducible).
+//   void *reservoir_new(long k, unsigned long long seed);
+//   long  reservoir_offer(void *r, long n, long *out_slots);  // -> kept
+//   void  reservoir_state(void *r, unsigned long long out[6]);
+//   void *reservoir_from_state(const unsigned long long st[6]);
+//   void  reservoir_free(void *r);
 //
 // Build: g++ -O3 -shared -fPIC reservoir.cc -o libreservoir.so
 // (fuzzyheavyhitters_tpu/native/__init__.py does this on first use).
@@ -105,9 +118,64 @@ bool parse_cols(const char *line, int col_a, int col_b, double *a, double *b) {
   }
 }
 
+// Incremental algorithm-R reservoir over caller-owned slots.  Identical
+// math to the CSV path (same RNG, same below()), factored so the ingest
+// plane can shed admissions one submission at a time.
+struct Reservoir {
+  Xoshiro256 rng;
+  long k;
+  long seen;
+  explicit Reservoir(long k_, uint64_t seed) : rng(seed), k(k_), seen(0) {}
+};
+
 }  // namespace
 
 extern "C" {
+
+void *reservoir_new(long k, unsigned long long seed) {
+  if (k <= 0) return nullptr;
+  return new Reservoir(k, seed);
+}
+
+void reservoir_free(void *r) { delete static_cast<Reservoir *>(r); }
+
+// Offer n sequential items; out_slots[i] = slot in [0, k) the i-th item
+// lands in (replacing the occupant), or -1 when it is shed.  Returns the
+// number of items kept.
+long reservoir_offer(void *rp, long n, long *out_slots) {
+  Reservoir *r = static_cast<Reservoir *>(rp);
+  long kept = 0;
+  for (long i = 0; i < n; ++i) {
+    long slot;
+    if (r->seen < r->k) {
+      slot = r->seen;  // fill phase: sequential slots
+    } else {
+      uint64_t j = r->rng.below((uint64_t)r->seen + 1);
+      slot = ((long)j < r->k) ? (long)j : -1;
+    }
+    out_slots[i] = slot;
+    if (slot >= 0) ++kept;
+    ++r->seen;
+  }
+  return kept;
+}
+
+// State layout: [k, seen, s0, s1, s2, s3] — enough to resume the exact
+// sampling stream after a checkpoint restore.
+void reservoir_state(void *rp, unsigned long long out[6]) {
+  Reservoir *r = static_cast<Reservoir *>(rp);
+  out[0] = (unsigned long long)r->k;
+  out[1] = (unsigned long long)r->seen;
+  for (int i = 0; i < 4; ++i) out[2 + i] = r->rng.s[i];
+}
+
+void *reservoir_from_state(const unsigned long long st[6]) {
+  if ((long)st[0] <= 0) return nullptr;
+  Reservoir *r = new Reservoir((long)st[0], 0);
+  r->seen = (long)st[1];
+  for (int i = 0; i < 4; ++i) r->rng.s[i] = st[2 + i];
+  return r;
+}
 
 long csv_reservoir_sample(const char *path, int col_a, int col_b, long k,
                           unsigned long long seed, double *out_a,

@@ -47,6 +47,13 @@ Well-known metric names (what populates them):
   (``{count, levels_rerun, shards_rerun, dedup_hits, dedup_hit_rate}``)
   whenever any supervised component ran, so a recovered run is
   distinguishable from a fault-free one in the report alone.
+- counters ``ingest_admitted`` / ``ingest_shed`` / ``ingest_rejected`` /
+  ``ingest_windows`` + phases ``ingest`` / ``window_crawl`` (the
+  windowed front-door driver's dedicated registry,
+  leader_rpc.WindowedIngest) — rolled up into a top-level ``ingest``
+  section (``{admitted, shed, rejected, windows, keys_per_sec,
+  window_crawl_seconds}``) whenever a streaming run happened; servers
+  additionally keep ``pool_*`` counters surfaced by the ``status`` verb.
 
 ``FHH_RUN_REPORT=<path>`` makes the binaries (and bench) write the
 report there at exit / on SIGTERM; :func:`maybe_write_run_report` is
@@ -112,6 +119,9 @@ def run_report(registries=None) -> dict:
     sk = _secure_kernel_summary(out)
     if sk is not None:
         doc["secure_kernels"] = sk
+    ing = _ingest_summary(out)
+    if ing is not None:
+        doc["ingest"] = ing
     if dropped:
         doc["dropped_registries"] = dropped
     return doc
@@ -258,6 +268,49 @@ def _secure_kernel_summary(registries: dict) -> dict | None:
             lvl: {n: round(v[n], 6) for n in names}
             for lvl, v in sorted(by_level.items(), key=lambda kv: int(kv[0]))
         },
+    }
+
+
+def _ingest_summary(registries: dict) -> dict | None:
+    """Cross-registry streaming-ingest rollup (the windowed front door,
+    protocol/leader_rpc.WindowedIngest): admitted/shed keys and rejected
+    (Overloaded) attempts, sealed-window count, sustained admission rate
+    over the ingest phase's wall-clock, and the windowed crawls' total
+    seconds.  The driver's dedicated ``ingest`` registry is the source
+    of truth (servers keep their own ``pool_*`` counters for ``status``);
+    present only when a streaming run happened — batch-upload runs omit
+    the section entirely."""
+    names = ("ingest_admitted", "ingest_shed", "ingest_rejected",
+             "ingest_windows")
+    sums = dict.fromkeys(names, 0)
+    ingest_s = crawl_s = 0.0
+    seen = False
+    for snap in registries.values():
+        counters = snap.get("counters", {})
+        for n in names:
+            if n in counters:
+                seen = True
+                sums[n] += counters[n].get("total", 0)
+        phases = snap.get("phases", {})
+        t = phases.get("ingest")
+        if t is not None:
+            seen = True
+            ingest_s += t.get("seconds", 0.0)
+        t = phases.get("window_crawl")
+        if t is not None:
+            seen = True
+            crawl_s += t.get("seconds", 0.0)
+    if not seen:
+        return None
+    return {
+        "admitted": sums["ingest_admitted"],
+        "shed": sums["ingest_shed"],
+        "rejected": sums["ingest_rejected"],
+        "windows": sums["ingest_windows"],
+        "keys_per_sec": round(
+            sums["ingest_admitted"] / ingest_s, 2
+        ) if ingest_s > 0 else None,
+        "window_crawl_seconds": round(crawl_s, 6),
     }
 
 

@@ -267,6 +267,7 @@ class RpcLeader:
 
         t_level = time.monotonic()
         it = iter(spans)
+        # fhh-lint: disable=unbounded-queue (bounded by construction: at most `depth` span tasks live at once — the refill below only appends after the head pops)
         window: _collections.deque = _collections.deque()
         for _ in range(depth):
             span = next(it, None)
@@ -330,14 +331,8 @@ class RpcLeader:
         await self._all(
             self.c0.call("plane_break"), self.c1.call("plane_break")
         )
-        st0 = await self._probe(self.c0)
-        await self.c0.call("plane_reset")
-        st1 = await self._probe(self.c1)
-        for i, st in enumerate((st0, st1)):
-            known = self._boot_ids.get(i)
-            if known is not None and st["boot_id"] != known:
-                raise err  # restarted server: full recovery owns it
-            self._boot_ids[i] = st["boot_id"]
+        if await self._reprobe_and_reset():
+            raise err  # restarted server: full recovery owns it
 
     async def _shard_call(self, verb: str, req: dict):
         """One shard's verbs on both servers, retried under the shared
@@ -358,14 +353,8 @@ class RpcLeader:
                 attempt += 1
                 if isinstance(err, ServerRestartedError) or attempt >= pol.attempts:
                     raise
-                st0 = await self._probe(self.c0)
-                await self.c0.call("plane_reset")
-                st1 = await self._probe(self.c1)
-                for i, st in enumerate((st0, st1)):
-                    known = self._boot_ids.get(i)
-                    if known is not None and st["boot_id"] != known:
-                        raise  # restarted server: full recovery owns it
-                    self._boot_ids[i] = st["boot_id"]
+                if await self._reprobe_and_reset():
+                    raise  # restarted server: full recovery owns it
                 self.obs.count("shards_rerun", level=int(req["level"]))
                 obsmod.emit(
                     "resilience.shard_rerun",
@@ -512,6 +501,23 @@ class RpcLeader:
             return await client.call("status")
         except ServerRestartedError:
             return await client.call("status")
+
+    async def _reprobe_and_reset(self) -> list:
+        """The shared fault-recovery preamble: probe s0, re-establish the
+        data plane via the dialer, probe s1, and diff both boot ids
+        against the known table (updating it).  Returns the indices of
+        servers that RESTARTED — a previously-unknown boot id is learned,
+        not treated as a restart."""
+        st0 = await self._probe(self.c0)
+        await self.c0.call("plane_reset")
+        st1 = await self._probe(self.c1)
+        restarted = []
+        for i, st in enumerate((st0, st1)):
+            known = self._boot_ids.get(i)
+            if known is not None and st["boot_id"] != known:
+                restarted.append(i)
+            self._boot_ids[i] = st["boot_id"]
+        return restarted
 
     async def _recover(self, keys0, keys1, sketch0, sketch1, stash) -> int:
         """Bring both servers back to one consistent state after any
@@ -777,3 +783,358 @@ class RpcLeader:
         if np.any(v[..., 1:]) or not np.array_equal(final_counts, counts_kept):
             raise RuntimeError("final share reconstruction mismatch")
         return CrawlResult(paths=self.paths, counts=final_counts)
+
+
+# ---------------------------------------------------------------------------
+# Streaming ingest: the windowed front-door driver
+# ---------------------------------------------------------------------------
+
+# a client's submit backoff: quick first retries (token refill horizons
+# are sub-second at sane rates), generous attempt count — a flooding
+# client spends its time HERE, sleeping, which is exactly the
+# backpressure story (it stalls itself, not the crawl)
+INGEST_POLICY = respolicy.RetryPolicy(
+    base_s=0.02, cap_s=0.5, factor=2.0, attempts=16
+)
+
+
+class IngestOverloadedError(RuntimeError):
+    """Every backed-off retry of one submission was answered Overloaded —
+    the caller is flooding faster than the front door will ever admit.
+    Deliberately NOT transport-shaped: retrying at the RPC layer cannot
+    help, the caller must slow down or drop."""
+
+
+class WindowedIngest:
+    """Leader-side driver of the streaming front door: continuous
+    ``submit_keys`` into tumbling windows, ``seal_window`` at each
+    boundary, and :meth:`crawl_window` running the NORMAL level loop on
+    the frozen snapshot while later submissions keep landing — the
+    online successor of the one-bulk-upload ``upload_keys`` flow.
+
+    Admission protocol: server 0 is the GATE — its verdict (admit slot /
+    shed / Overloaded) is obtained first, then MIRRORED to server 1, so
+    the two pools stay positionally identical without requiring the two
+    servers to agree on arrival order.  Overloaded verdicts back off
+    under ``policy`` (honoring the gate's ``retry_after_s`` hint) and
+    re-attempt: a flooding client stalls itself.
+
+    Window-consistent recovery: every admitted/shed submission is
+    journaled until its window's crawl completes.  On a server restart
+    the driver restores the last ingest checkpoint (``tree_restore`` of
+    the ``ingest_only`` blob banked at each seal — pools, per-``sub_id``
+    verdicts, reservoir RNG state), then REPLAYS the journal in mirror
+    form — the recorded-verdict dedup makes the combination exactly-once
+    (nothing lost, nothing double-counted) — re-seals, reloads, and
+    re-runs the window's crawl.  Results are bit-exact vs a batch crawl
+    over the same admitted key set (asserted in tests and bench)."""
+
+    def __init__(self, lead: RpcLeader, *, policy=None, checkpoint=True):
+        self.lead = lead
+        self.cfg = lead.cfg
+        self.window = 0
+        self.policy = policy or INGEST_POLICY
+        # a dedicated registry so the heartbeat names the ingest phase
+        # (span "ingest" per window) independently of the crawl's spans
+        self.obs = obsmetrics.Registry("ingest")
+        self._span_ctx = None
+        self._journal: dict[int, list] = {}  # window -> submission records
+        self._journaled: set = set()  # sub_ids already journaled
+        self._sealed: dict[int, dict] = {}  # window -> seal stats
+        self._n_subs = 0
+        self._ckpt = bool(checkpoint)
+        self._have_ckpt = False
+        # gate->mirror ordering: the mirror MUST apply verdicts in the
+        # gate's decision order (pools are positional), so the
+        # gate-call + mirror-call pair serializes on this lock; backoff
+        # sleeps happen OUTSIDE it, so a rejected (flooding) client
+        # stalls only itself while others keep submitting
+        self._submit_lock = asyncio.Lock()
+        # one recovery at a time: a concurrent submit and crawl may both
+        # observe the same fault — the second waiter re-probes (cheap,
+        # idempotent) instead of interleaving journal replays
+        self._recover_lock = asyncio.Lock()
+        # seed restart detection: recovery compares boot ids against the
+        # ones the clients learned at connect time
+        for i, c in ((0, lead.c0), (1, lead.c1)):
+            if lead._boot_ids.get(i) is None:
+                lead._boot_ids[i] = getattr(c, "boot_id", None)
+
+    # -- window lifecycle -------------------------------------------------
+
+    def _ensure_span(self) -> None:
+        if self._span_ctx is None:
+            self._span_ctx = self.obs.span("ingest", level=self.window)
+            self._span_ctx.__enter__()
+
+    def _exit_span(self) -> None:
+        if self._span_ctx is not None:
+            self._span_ctx.__exit__(None, None, None)
+            self._span_ctx = None
+
+    async def submit(self, client_id, k0_chunk, k1_chunk, *,
+                     sub_id: str | None = None) -> dict:
+        """Submit one client's key-share chunks (k0 to server 0, k1 to
+        server 1) into the CURRENT window.  Blocks through Overloaded
+        verdicts under the backoff policy; returns the gate verdict
+        (``admitted`` or ``shed``).  Raises
+        :class:`IngestOverloadedError` when every attempt was rejected."""
+        self._ensure_span()
+        if sub_id is None:
+            # unique per LOGICAL submission; reused across transport
+            # replays and recovery re-submissions so the servers'
+            # recorded verdicts dedup them.  Window-independent: a
+            # backed-off retry that straddles a seal lands in the NEW
+            # current window under the same id.
+            self._n_subs += 1
+            sub_id = f"{self.lead.c0.session_id}:{self._n_subs}"
+        k0_chunk = tuple(np.asarray(a) for a in k0_chunk)
+        k1_chunk = tuple(np.asarray(a) for a in k1_chunk)
+        n_keys = int(k0_chunk[0].shape[0])
+        attempt = 0
+        faults = 0
+        while True:
+            # the lock pins gate-order == mirror-order (positional
+            # pools); a rejected attempt releases it before backing off.
+            # The window id is read UNDER the lock: a concurrent
+            # seal_window may have advanced it while this task waited,
+            # and a stale id would target a sealed window.
+            async with self._submit_lock:
+                w = self.window
+                base = {
+                    "window": w, "sub_id": sub_id,
+                    "client_id": str(client_id),
+                }
+                # the gate+mirror pair (and its fault recovery) runs
+                # entirely under this lock hold: once the gate admits,
+                # nothing — not even a seal — may interleave before the
+                # mirror lands, or the two pools' seal stats diverge
+                while True:
+                    try:
+                        r0 = await self.lead.c0.call(
+                            "submit_keys", dict(base, keys=k0_chunk)
+                        )
+                        if r0.get("overloaded"):
+                            break
+                        rec = {
+                            "sub_id": sub_id,
+                            "client_id": str(client_id),
+                            "window": w,
+                            "slot": r0.get("slot"),
+                            "shed": bool(r0.get("shed")),
+                            "k0": k0_chunk,
+                            "k1": k1_chunk,
+                        }
+                        # journal BEFORE the mirror call: if s1 restarts
+                        # mid-mirror, the recovery replay carries this
+                        # record and the retried mirror dedups against it
+                        # (a faulted retry of the same sub_id — the gate
+                        # answers it as a dup — must not journal twice)
+                        if sub_id not in self._journaled:
+                            self._journal.setdefault(w, []).append(rec)
+                            self._journaled.add(sub_id)
+                        await self.lead.c1.call(
+                            "submit_keys",
+                            dict(
+                                base, keys=k1_chunk,
+                                mirror={"slot": rec["slot"],
+                                        "shed": rec["shed"]},
+                            ),
+                        )
+                        break
+                    except respolicy.TRANSIENT_ERRORS:
+                        # restart or transport loss mid-submission:
+                        # recover the ingest state and re-attempt the
+                        # SAME sub_id — recorded verdicts make the
+                        # retry exactly-once
+                        faults += 1
+                        if faults > 8:
+                            raise
+                        await self._recover_ingest()
+            if not r0.get("overloaded"):
+                break
+            if r0.get("scope") == "burst":
+                # permanent by configuration: no refill horizon ever
+                # covers a chunk larger than the burst — backing off
+                # would be 16 futile round trips
+                self.obs.count("ingest_rejected", level=w)
+                raise IngestOverloadedError(
+                    f"submission {sub_id} ({n_keys} keys) exceeds the "
+                    "gate's burst allowance — split the chunk or raise "
+                    "ingest_burst_keys"
+                )
+            attempt += 1
+            self.obs.count("ingest_rejected", level=w)
+            if attempt >= self.policy.attempts:
+                raise IngestOverloadedError(
+                    f"submission {sub_id} rejected {attempt} times "
+                    f"(last scope: {r0.get('scope')}) — client is "
+                    "over the front door's sustained capacity"
+                )
+            await asyncio.sleep(
+                max(
+                    float(r0.get("retry_after_s", 0.0)),
+                    self.policy.delay(attempt - 1),
+                )
+            )
+        if rec["shed"]:
+            self.obs.count("ingest_shed_subs", level=w)
+        else:
+            self.obs.count("ingest_admitted", n_keys, level=w)
+        return r0
+
+    async def seal_window(self) -> dict:
+        """Freeze the current window on both servers (tumbling-window
+        boundary), bank the ingest checkpoint, and open the next window.
+        Returns the gate's seal stats (keys/subs/shed/rejected)."""
+        w = self.window
+        faults = 0
+        while True:
+            # under the submit lock: the boundary must not race a
+            # half-mirrored submission (gate applied, mirror in flight)
+            async with self._submit_lock:
+                try:
+                    r0, r1 = await self.lead._both(
+                        "window_seal", {"window": w}
+                    )
+                    break
+                except respolicy.TRANSIENT_ERRORS:
+                    faults += 1
+                    if faults > 8:
+                        raise
+                    await self._recover_ingest()
+        if (r0["keys"], r0["subs"]) != (r1["keys"], r1["subs"]):
+            raise RuntimeError(
+                f"window {w} pools diverged at seal: gate {r0} vs mirror {r1}"
+            )
+        self._exit_span()
+        self._sealed[w] = r0
+        # shed keys include reservoir-replaced occupants the driver
+        # cannot see per-submit — the seal stats are authoritative
+        self.obs.count("ingest_shed", int(r0["shed_keys"]), level=w)
+        self.obs.count("ingest_windows")
+        obsmod.emit(
+            "ingest.window_boundary",
+            window=w,
+            keys=int(r0["keys"]),
+            subs=int(r0["subs"]),
+            shed=int(r0["shed_keys"]),
+            rejected=int(r0["rejected"]),
+        )
+        self.window = w + 1
+        if self._ckpt:
+            # bank the pools at the boundary: the rollback point a
+            # kill-mid-window recovers to (ingest-only blob, level -1)
+            try:
+                await self.lead._both(
+                    "tree_checkpoint", {"level": -1, "ingest_only": True}
+                )
+                self._have_ckpt = True
+            except RuntimeError as e:
+                self._ckpt = False  # no FHH_CKPT_DIR: journal replay covers
+                obsmod.emit(
+                    "ingest.checkpoint_disabled", severity="warn", error=str(e)
+                )
+        return r0
+
+    # -- windowed crawl + recovery ---------------------------------------
+
+    async def crawl_window(self, w: int, *, max_recoveries: int = 4):
+        """Run the normal level loop over SEALED window ``w``'s frozen
+        pool; ingest into later windows continues concurrently
+        (``submit_keys`` bypasses the servers' verb lock).  On any
+        transport loss / server restart the driver recovers ingest state
+        (checkpoint restore + journal replay), reloads the window, and
+        re-runs its crawl — results stay bit-exact because the frozen
+        pool is reconstructed exactly and the crawl is deterministic."""
+        stats = self._sealed.get(w)
+        if stats is None:
+            raise RuntimeError(f"crawl_window: window {w} is not sealed")
+        nreqs = int(stats["keys"])
+        recoveries = 0
+        while True:
+            try:
+                await self.lead._both("window_load", {"window": w})
+                with self.obs.span("window_crawl", level=w):
+                    res = await self.lead.run(nreqs)
+                break
+            except (ConnectionError, TimeoutError, RuntimeError) as err:
+                recoveries += 1
+                self.obs.count("ingest_recoveries")
+                obsmod.emit(
+                    "ingest.window_recover",
+                    severity="warn",
+                    window=w,
+                    attempt=recoveries,
+                    error=f"{type(err).__name__}: {err}",
+                )
+                if recoveries > max_recoveries:
+                    raise
+                try:
+                    await self._recover_ingest()
+                except respolicy.TRANSIENT_ERRORS:
+                    # a server still coming back up: the next loop turn
+                    # re-probes (bounded by max_recoveries)
+                    continue
+        # the window is crawled: its journal, journaled-id set, and seal
+        # stats (and any earlier) are done — bounded driver memory
+        # mirrors the servers' bounded pools
+        for old in [k for k in self._journal if k <= w]:
+            for rec in self._journal[old]:
+                self._journaled.discard(rec["sub_id"])
+            del self._journal[old]
+        for old in [k for k in self._sealed if k <= w]:
+            del self._sealed[old]
+        return res
+
+    async def _recover_ingest(self) -> None:
+        """Bring both servers' INGEST state back to this driver's view:
+        probe boot ids, re-key the data plane, and for every restarted
+        server restore the last ingest checkpoint then replay the journal
+        (mirror form, recorded verdicts deduping) and re-seal the sealed
+        windows.  The crawl state is NOT restored here — the caller
+        reloads the window and re-runs, which rebuilds it exactly."""
+        async with self._recover_lock:
+            await self._recover_ingest_locked()
+
+    async def _recover_ingest_locked(self) -> None:
+        lead = self.lead
+        restarted = await lead._reprobe_and_reset()
+        for i in restarted:
+            client = lead.c0 if i == 0 else lead.c1
+            if self._have_ckpt:
+                try:
+                    await client.call("tree_restore", {"level": -1})
+                except RuntimeError as e:
+                    obsmod.emit(
+                        "ingest.restore_failed",
+                        severity="warn",
+                        server=i,
+                        error=str(e),
+                    )
+            await self._replay_journal(client, i)
+            for w in sorted(self._sealed):
+                await client.call("window_seal", {"window": w})
+            obsmod.emit("ingest.server_reseeded", server=i)
+
+    async def _replay_journal(self, client, which: int) -> None:
+        """Re-submit every journaled record to ONE server in mirror form
+        (the recorded gate verdicts, not fresh admission): entries the
+        restored checkpoint already carries answer as dups, the rest
+        rebuild the pool positionally identical to the pre-fault one."""
+        replayed = 0
+        for w in sorted(self._journal):
+            for rec in self._journal[w]:
+                await client.call(
+                    "submit_keys",
+                    {
+                        "window": rec["window"],
+                        "sub_id": rec["sub_id"],
+                        "client_id": rec["client_id"],
+                        "keys": rec["k0"] if which == 0 else rec["k1"],
+                        "mirror": {"slot": rec["slot"], "shed": rec["shed"]},
+                    },
+                )
+                replayed += 1
+        if replayed:
+            self.obs.count("ingest_journal_replays", replayed)

@@ -46,6 +46,19 @@ reference restarts the whole run on any socket error):
   re-establishes the server↔server data plane (redial + fresh
   ``_plane_handshake``) after a peer loss — together they let
   ``RpcLeader.run_supervised`` re-run only the lost levels.
+
+Streaming ingestion (the online front door, ROADMAP "Streaming
+ingestion"): instead of one bulk ``add_keys`` upload, clients submit key
+chunks continuously via ``submit_keys`` into per-window append-only
+pools, gated by resilience/admission.py (token-bucket rate limits,
+per-client quotas, bounded pools, reject-vs-reservoir shedding);
+``window_seal`` freezes a window at its boundary and ``window_load``
+materializes the frozen snapshot as the crawl's key batch — the normal
+level loop then runs on it while ingest keeps landing in later windows
+(``submit_keys`` bypasses the verb lock like ``add_keys``).  Pools ride
+``tree_checkpoint``/``tree_restore`` (entry slots, per-``sub_id``
+verdicts, reservoir RNG state), so a kill mid-window neither loses nor
+double-counts admitted keys.
 """
 
 from __future__ import annotations
@@ -69,6 +82,7 @@ from ..obs import metrics as obsmetrics
 from ..ops import baseot, dpf, gc, ibdcf, otext, prg
 from ..ops.fields import F255, FE62
 from ..ops.ibdcf import EvalState, IbDcfKeyBatch
+from ..resilience import admission as resadmission
 from ..resilience import policy as respolicy
 from ..utils.config import Config
 from . import collect, mpc, secure, sketch as sketchmod
@@ -245,6 +259,149 @@ class _Session:
             self.bytes_total -= self.sizes.pop(old, 0)
 
 
+class _WindowPool:
+    """One ingest window's append-only key pool (the streaming front
+    door's unit of work: protocol verbs ``submit_keys`` → ``window_seal``
+    → ``window_load``).
+
+    ``entries`` holds admitted submissions (tuples of key arrays, the
+    same chunk shape ``add_keys`` receives) in arrival order; once the
+    reservoir shed policy engages, the list freezes into a SLOT TABLE
+    and replacements overwrite in place.  ``verdicts`` records every
+    FINAL outcome by ``sub_id`` so at-least-once delivery (reconnect
+    replays, recovery journal replays) answers the recorded verdict
+    instead of double-admitting or re-advancing the sampler's RNG.
+    Overloaded rejections are deliberately NOT recorded — a backed-off
+    retry is a fresh attempt against refilled tokens."""
+
+    __slots__ = (
+        "window", "wa", "entries", "verdicts", "keys",
+        "admitted_keys", "shed_keys", "rejected", "sealed",
+    )
+
+    def __init__(self, window: int, wa: resadmission.WindowAdmission):
+        self.window = int(window)
+        self.wa = wa
+        self.entries: list = []
+        self.verdicts: dict = {}
+        self.keys = 0
+        self.admitted_keys = 0
+        self.shed_keys = 0
+        self.rejected = 0
+        self.sealed = False
+
+    def apply(self, sub_id: str, chunk: tuple,
+              v: resadmission.Verdict) -> dict:
+        """Commit one gate verdict to the pool; returns the wire
+        response (the mirror server replays it via :meth:`apply_mirror`)."""
+        n_keys = int(chunk[0].shape[0])
+        if not v.admitted and v.scope is not None:
+            self.rejected += 1
+            return {
+                "admitted": False, "overloaded": True, "scope": v.scope,
+                "retry_after_s": round(float(v.retry_after_s), 4),
+                "window": self.window,
+            }
+        if not v.admitted:  # reservoir shed this submission
+            resp = {"admitted": False, "shed": True, "window": self.window}
+            self.verdicts[sub_id] = resp
+            self.shed_keys += n_keys
+            return resp
+        if v.slot is None:
+            self.entries.append(chunk)
+            self.keys += n_keys
+        else:
+            old = self.entries[v.slot]
+            old_n = int(old[0].shape[0])
+            self.entries[v.slot] = chunk
+            self.keys += n_keys - old_n
+            self.shed_keys += old_n
+            # keep the admission ledger's occupancy honest under
+            # variable-size chunks
+            self.wa.keys += n_keys - old_n
+        self.admitted_keys += n_keys
+        resp = {"admitted": True, "slot": v.slot, "window": self.window}
+        self.verdicts[sub_id] = resp
+        return resp
+
+    def apply_mirror(self, sub_id: str, chunk: tuple, mirror: dict,
+                     client_id: str | None = None) -> dict:
+        """Replay the GATE server's verdict on the peer pool so both
+        servers' windows stay positionally identical.  Validates loudly —
+        a mirror that cannot apply means the two pools diverged, which
+        must never be papered over."""
+        n_keys = int(chunk[0].shape[0])
+        slot = mirror.get("slot")
+        if self.wa.shed == resadmission.SHED_RESERVOIR:
+            if self.wa.sub_keys is None:
+                self.wa.sub_keys = n_keys  # uniform-chunk contract holds
+            if mirror.get("shed") or slot is not None:
+                # a restored GATE being rebuilt by the recovery journal:
+                # the replayed verdict consumed one sampler draw in its
+                # first life — advance the restored stream past it (the
+                # verdict itself is applied verbatim below), so
+                # post-recovery live admissions continue the SAME
+                # seed-reproducible sequence.  When the reservoir
+                # engaged only AFTER the last checkpoint, there is no
+                # sampler to advance yet: bank the draw so the eventual
+                # engagement fast-forwards past it.  A mirror server
+                # never re-engages a reservoir, so this is harmless
+                # bookkeeping outside recovery.
+                if self.wa.reservoir is not None:
+                    self.wa.reservoir.offer(1)
+                else:
+                    self.wa.pending_draws += 1
+        if mirror.get("shed"):
+            resp = {"admitted": False, "shed": True, "window": self.window}
+            self.verdicts[sub_id] = resp
+            self.shed_keys += n_keys
+            return resp
+        if slot is None:
+            if self.keys + n_keys > self.wa.max_keys:
+                raise RuntimeError(
+                    f"ingest mirror overflows window {self.window}: "
+                    f"{self.keys} + {n_keys} > {self.wa.max_keys} "
+                    "(gate/mirror pools diverged)"
+                )
+            self.entries.append(chunk)
+            self.keys += n_keys
+            # keep the admission ledger in lockstep: a recovery journal
+            # replay rebuilds a restarted GATE through this path, and its
+            # later live decisions must see the true occupancy
+            self.wa.subs += 1
+            self.wa.keys += n_keys
+            self.wa._charge(client_id, n_keys)
+        else:
+            slot = int(slot)
+            if not 0 <= slot < len(self.entries):
+                raise RuntimeError(
+                    f"ingest mirror names slot {slot} of a "
+                    f"{len(self.entries)}-slot window {self.window} pool "
+                    "(gate/mirror pools diverged)"
+                )
+            old_n = int(self.entries[slot][0].shape[0])
+            self.entries[slot] = chunk
+            self.keys += n_keys - old_n
+            self.shed_keys += old_n
+            self.wa.keys += n_keys - old_n
+            self.wa._charge(client_id, n_keys)
+        self.admitted_keys += n_keys
+        resp = {"admitted": True, "slot": slot, "window": self.window}
+        self.verdicts[sub_id] = resp
+        return resp
+
+    def stats(self) -> dict:
+        return {
+            "window": self.window,
+            "sealed": self.sealed,
+            "keys": self.keys,
+            "subs": len(self.entries),
+            "admitted_keys": self.admitted_keys,
+            "shed_keys": self.shed_keys,
+            "rejected": self.rejected,
+        }
+
+
 @dataclass
 class CollectorServer:
     """One collector server process (ref: server.rs:44-172).
@@ -313,10 +470,25 @@ class CollectorServer:
     _sessions: dict = field(default_factory=dict)
     _peer_addr: tuple | None = None
     _ctl_writers: set = field(default_factory=set)
+    # streaming ingest front door: bounded per-window key pools
+    # (submit_keys → window_seal → window_load) and the admission gate
+    # (resilience/admission.py) deciding admit/shed/Overloaded; tests
+    # may swap _admission for one with a manual clock
+    _ingest_pools: dict = field(default_factory=dict)
+    _admission: object | None = None
 
     def __post_init__(self):
         if self.obs is None:
             self.obs = obsmetrics.Registry(f"server{self.server_id}")
+        if self._admission is None:
+            self._admission = resadmission.AdmissionController(
+                max_window_keys=self.cfg.ingest_window_keys,
+                rate_keys_per_s=self.cfg.ingest_rate_keys_per_s,
+                burst_keys=self.cfg.ingest_burst_keys,
+                client_quota=self.cfg.ingest_client_quota,
+                shed=self.cfg.ingest_shed,
+                seed=self.cfg.ingest_seed,
+            )
 
     # -- verbs (ref: rpc.rs:56-66) ---------------------------------------
 
@@ -340,6 +512,7 @@ class CollectorServer:
         self._sketch_pairs_field = None
         self._sketch_root = None
         self._ratchet_digest = None
+        self._ingest_pools.clear()  # a new collection's front door opens clean
         self._ckpt_clear()  # a new collection must not resume an old one's
         self.obs.reset()  # fresh per-collection phase/byte/fetch accounting
         if self._ot is not None:  # fresh GC/b2a randomness per collection
@@ -1087,6 +1260,188 @@ class CollectorServer:
         live with the leader in this design, see protocol/collect.py)."""
         return {"server_id": self.server_id, "shares": self._last_shares}
 
+    # -- streaming ingest front door (ROADMAP "Streaming ingestion": the
+    # online successor of the one-shot add_keys upload) ------------------
+
+    def _ingest_pool(self, window: int) -> _WindowPool:
+        """Create-or-get the pool for ``window``; live-window count is
+        BOUNDED (``cfg.ingest_windows_retained``) so a runaway window id
+        can never grow server memory — the refusal is loud, never a
+        silent drop."""
+        pool = self._ingest_pools.get(window)
+        if pool is None:
+            if len(self._ingest_pools) >= max(
+                1, self.cfg.ingest_windows_retained
+            ):
+                # sealed EMPTY windows are fully consumed (window_load
+                # skips them, so only loads drop pools): evict the
+                # oldest such before refusing — a quiet stretch of idle
+                # windows must not wedge the front door
+                idle = [
+                    w for w in sorted(self._ingest_pools)
+                    if self._ingest_pools[w].sealed
+                    and not self._ingest_pools[w].entries
+                ]
+                if idle:
+                    del self._ingest_pools[idle[0]]
+            if len(self._ingest_pools) >= max(
+                1, self.cfg.ingest_windows_retained
+            ):
+                raise RuntimeError(
+                    f"ingest window {window} would exceed the "
+                    f"{self.cfg.ingest_windows_retained} live-window bound "
+                    f"(live: {sorted(self._ingest_pools)})"
+                )
+            pool = self._ingest_pools[window] = _WindowPool(
+                window, self._admission.window(window)
+            )
+        return pool
+
+    async def submit_keys(self, req) -> dict:
+        """Streaming key submission into the named window's pool —
+        admission-controlled, append-only, idempotent per ``sub_id``.
+
+        Dispatches WITHOUT the verb lock (like ``add_keys``: no awaits,
+        so it is atomic on the event loop) — ingest rides concurrently
+        with a crawl holding the lock, which is what lets a window
+        accrue while the previous window's frozen snapshot is crawled.
+
+        Req: ``{window, sub_id, client_id, keys: chunk}`` plus an
+        optional ``mirror`` dict carrying the GATE server's verdict —
+        the leader-side driver gets the admission decision from server 0
+        and replays it onto server 1, so the two pools stay positionally
+        identical (admission must never diverge between the servers).
+
+        Verdicts: ``{"admitted": True, slot}``, ``{"admitted": False,
+        "shed": True}`` (reservoir mode — final), or ``{"admitted":
+        False, "overloaded": True, scope, retry_after_s}`` (retryable:
+        the client's RetryPolicy backs off and re-attempts)."""
+        if self.cfg.malicious:
+            raise RuntimeError(
+                "streaming ingest does not carry sketch material yet — "
+                "malicious mode uses the batch add_keys path"
+            )
+        window = int(req["window"])
+        sub_id = str(req["sub_id"])
+        chunk = tuple(np.asarray(a) for a in req["keys"])
+        n_keys = int(chunk[0].shape[0])
+        pool = self._ingest_pool(window)
+        self.obs.count("pool_submits")
+        prev = pool.verdicts.get(sub_id)
+        if prev is not None:
+            # at-least-once delivery made safe: a replayed submission
+            # (reconnect replay under a new req_id, recovery journal
+            # replay) answers its RECORDED verdict — the pool and the
+            # reservoir RNG are untouched, so nothing double-admits
+            self.obs.count("pool_dup_submits")
+            return dict(prev, dup=True)
+        if pool.sealed:
+            raise RuntimeError(
+                f"ingest window {window} is sealed — submit into a later "
+                "window"
+            )
+        mirror = req.get("mirror")
+        if mirror is not None:
+            resp = pool.apply_mirror(
+                sub_id, chunk, mirror, str(req.get("client_id", ""))
+            )
+        else:
+            v = self._admission.admit(
+                pool.wa, str(req.get("client_id", "")), n_keys
+            )
+            resp = pool.apply(sub_id, chunk, v)
+        if resp.get("admitted"):
+            self.obs.count("pool_admitted_keys", n_keys)
+        elif resp.get("shed"):
+            self.obs.count("pool_shed_keys", n_keys)
+        else:
+            self.obs.count("pool_rejected")
+        return resp
+
+    async def window_seal(self, req) -> dict:
+        """Freeze the named window at its boundary: no further
+        submissions land in it (later ``submit_keys`` name later
+        windows); returns the pool stats.  Idempotent — re-sealing a
+        sealed window (recovery replays) returns the same stats."""
+        w = int(req["window"])
+        pool = self._ingest_pools.get(w)
+        if pool is None:
+            pool = self._ingest_pool(w)  # sealing an idle window is legal
+        if not pool.sealed:
+            pool.sealed = True
+            self.obs.count("windows_sealed")
+            obs.emit(
+                "ingest.window_sealed",
+                server=self.server_id,
+                window=w,
+                keys=pool.keys,
+                subs=len(pool.entries),
+                shed=pool.shed_keys,
+            )
+        return pool.stats()
+
+    async def window_load(self, req) -> dict:
+        """Materialize a SEALED window's frozen pool as the crawl's key
+        batch (the streaming twin of the ``add_keys`` upload): the crawl
+        state resets to empty, ``keys_parts`` becomes the pool's
+        admitted chunks in slot order, and the normal ``tree_init`` →
+        level loop runs on it — while ``submit_keys`` keeps landing in
+        later windows.  Ingest pools and checkpoint files are untouched;
+        consumed EARLIER windows are dropped (bounded live windows)."""
+        w = int(req["window"])
+        pool = self._ingest_pools.get(w)
+        if pool is None:
+            raise RuntimeError(f"window_load: no ingest pool for window {w}")
+        if not pool.sealed:
+            raise RuntimeError(f"window_load: window {w} is not sealed")
+        if not pool.entries:
+            raise RuntimeError(f"window_load: window {w} admitted no keys")
+        self.keys_parts = [IbDcfKeyBatch(*e) for e in pool.entries]
+        self.keys = None
+        self.alive_keys = None
+        self.frontier = None
+        self._children = None
+        self._last_shares = None
+        self._shard_children.clear()
+        self._shard_last.clear()
+        self._shard_level = None
+        self._expand_ready.clear()
+        for old in [k for k in self._ingest_pools if k < w]:
+            del self._ingest_pools[old]
+        obs.emit(
+            "ingest.window_loaded",
+            server=self.server_id,
+            window=w,
+            keys=pool.keys,
+        )
+        return {"window": w, "keys": pool.keys, "subs": len(pool.entries)}
+
+    def _ingest_status(self) -> dict:
+        """Front-door health for ``status``: per-window occupancy, the
+        unsealed-queue depth, and the admit/shed/reject counters —
+        enough for an operator (or a test) to see a stalled or shedding
+        ingest plane without scraping logs."""
+        pools = [self._ingest_pools[w] for w in sorted(self._ingest_pools)]
+        unsealed = [p for p in pools if not p.sealed]
+        return {
+            "current_window": (
+                unsealed[-1].window if unsealed
+                else (pools[-1].window if pools else None)
+            ),
+            "queue_depth": sum(p.keys for p in unsealed),
+            "admitted": sum(p.admitted_keys for p in pools),
+            "shed": sum(p.shed_keys for p in pools),
+            "rejected": sum(p.rejected for p in pools),
+            "windows": {
+                str(p.window): {
+                    "keys": p.keys,
+                    "subs": len(p.entries),
+                    "sealed": p.sealed,
+                }
+                for p in pools
+            },
+        }
+
     # -- resilience verbs (no reference analogue: the reference's only
     # recovery verb is reset, server.rs:64-69) ---------------------------
 
@@ -1105,6 +1460,9 @@ class CollectorServer:
             # supervisor's "latest checkpoint" source of truth (string
             # sorts would order l9 after l10 from level 10 on)
             "ckpt_levels": self._ckpt_levels(),
+            # streaming front-door health (pool occupancy per window,
+            # unsealed queue depth, admit/shed/reject counters)
+            "ingest": self._ingest_status(),
         }
 
     def _ckpt_levels(self) -> list:
@@ -1177,35 +1535,50 @@ class CollectorServer:
         frontier-following sketch DPF states, the stored (yet-unopened)
         pair shares, the committed ratchet root, and the transcript
         digest — everything a re-run needs to replay each level's
-        challenge bit-identically (see ``sketch.py``'s ratchet note)."""
+        challenge bit-identically (see ``sketch.py``'s ratchet note).
+
+        Streaming ingest pools ride EVERY checkpoint (``ing_*`` fields):
+        a server killed mid-window restores its admitted pools — entry
+        slots, recorded per-``sub_id`` verdicts, quota ledgers, and the
+        reservoir sampler's RNG state — so recovery neither loses nor
+        double-counts admitted keys and the shed stream resumes
+        seed-identically.  A server with pools but no frontier (between
+        windows) may checkpoint too: the blob is then ingest-only."""
         if self.ckpt_dir is None:
             raise RuntimeError(
                 "tree_checkpoint: no checkpoint dir configured "
                 "(start the server with FHH_CKPT_DIR set)"
             )
-        if self.frontier is None:
+        ing_only = bool((req or {}).get("ingest_only"))
+        if self.frontier is None and not self._ingest_pools:
             raise RuntimeError("tree_checkpoint before tree_init")
+        if ing_only and not self._ingest_pools:
+            raise RuntimeError("tree_checkpoint: no ingest pools to persist")
         level = int(req["level"])
-        st = self.frontier.states
-        # ONE stacked fetch for the whole blob (device_get of the pytree),
-        # not one sync per plane — through a remote-chip tunnel each fetch
-        # is a full round trip
-        fetch = {
-            "seed": st.seed,
-            "bit": st.bit,
-            "y_bit": st.y_bit,
-            "alive": self.frontier.alive,
-        }
-        if self._sketch is not None:
-            fetch["sk_state_seed"] = self._sketch_states.seed
-            fetch["sk_state_t"] = self._sketch_states.t
-            if self._sketch_pairs is not None:
-                fetch["sk_pairs"] = self._sketch_pairs[0]
-        blob = jax.device_get(fetch)
-        blob["alive_keys"] = np.asarray(self.alive_keys)
+        if self.frontier is not None and not ing_only:
+            st = self.frontier.states
+            # ONE stacked fetch for the whole blob (device_get of the
+            # pytree), not one sync per plane — through a remote-chip
+            # tunnel each fetch is a full round trip
+            fetch = {
+                "seed": st.seed,
+                "bit": st.bit,
+                "y_bit": st.y_bit,
+                "alive": self.frontier.alive,
+            }
+            if self._sketch is not None:
+                fetch["sk_state_seed"] = self._sketch_states.seed
+                fetch["sk_state_t"] = self._sketch_states.t
+                if self._sketch_pairs is not None:
+                    fetch["sk_pairs"] = self._sketch_pairs[0]
+            blob = jax.device_get(fetch)
+            blob["alive_keys"] = np.asarray(self.alive_keys)
+            blob["planar"] = np.bool_(collect._expand_engine())
+            blob["keys_fp"] = self._keys_fp()
+        else:
+            blob = {"ing_only": np.bool_(True)}
         blob["level"] = np.int64(level)
-        blob["planar"] = np.bool_(collect._expand_engine())
-        blob["keys_fp"] = self._keys_fp()
+        self._ingest_ckpt_fields(blob)
         if self._sketch is not None:
             blob["sk_pids"] = np.asarray(self._sketch_pids)
             blob["sk_depth"] = np.int64(self._sketch_depth)
@@ -1233,6 +1606,176 @@ class CollectorServer:
         )
         return {"level": level}
 
+    # verdict codes in the checkpoint blob: slot >= 0, -1 = appended in
+    # arrival order (no slot), -2 = reservoir-shed
+    _ING_APPEND, _ING_SHED = -1, -2
+
+    def _ingest_ckpt_fields(self, blob: dict) -> None:
+        """Flatten every live ingest pool into ``ing_*`` npz fields:
+        per window, the meta/counters row, the per-``sub_id`` verdict
+        table, the entry slot table (per-leaf concatenation + lengths),
+        the quota ledger, and the reservoir RNG state when the shed
+        sampler engaged."""
+        ws = sorted(self._ingest_pools)
+        if not ws:
+            return
+        blob["ing_windows"] = np.asarray(ws, np.int64)
+        for i, w in enumerate(ws):
+            p = self._ingest_pools[w]
+            blob[f"ing{i}_meta"] = np.array(
+                [w, int(p.sealed), p.keys, p.admitted_keys, p.shed_keys,
+                 p.rejected, len(p.entries), p.wa.subs, p.wa.keys,
+                 -1 if p.wa.sub_keys is None else p.wa.sub_keys,
+                 p.wa.pending_draws],
+                np.int64,
+            )
+            sub_ids, codes = [], []
+            for sid, resp in p.verdicts.items():
+                sub_ids.append(sid)
+                if resp.get("shed"):
+                    codes.append(self._ING_SHED)
+                elif resp.get("slot") is None:
+                    codes.append(self._ING_APPEND)
+                else:
+                    codes.append(int(resp["slot"]))
+            blob[f"ing{i}_sub_ids"] = np.array(sub_ids, dtype=str)
+            blob[f"ing{i}_sub_codes"] = np.array(codes, np.int64)
+            blob[f"ing{i}_lens"] = np.array(
+                [int(e[0].shape[0]) for e in p.entries], np.int64
+            )
+            n_leaf = len(p.entries[0]) if p.entries else 0
+            blob[f"ing{i}_nleaf"] = np.int64(n_leaf)
+            for j in range(n_leaf):
+                # entries are host arrays already (submit_keys converts)
+                blob[f"ing{i}_leaf{j}"] = np.concatenate(
+                    [e[j] for e in p.entries]
+                )
+            blob[f"ing{i}_clients"] = np.array(
+                list(p.wa.client_keys.keys()), dtype=str
+            )
+            blob[f"ing{i}_client_keys"] = np.array(
+                list(p.wa.client_keys.values()), np.int64
+            )
+            if p.wa.reservoir is not None:
+                blob[f"ing{i}_res"] = p.wa.reservoir.state()
+
+    def _ingest_validate(self, z: dict, path: str) -> list | None:
+        """Validate-before-mutate for the ``ing_*`` fields: parse every
+        window's record fully (shapes cross-checked) BEFORE any pool is
+        touched; a torn tail refuses loudly with live state intact.
+        Returns the parsed per-window records, or None when the blob
+        carries no ingest fields (a pre-streaming checkpoint)."""
+        if "ing_windows" not in z:
+            return None
+        parsed = []
+        ws = np.asarray(z["ing_windows"], np.int64)  # checkpoint blob: host
+        for i, w in enumerate(ws):
+            req_keys = {f"ing{i}_meta", f"ing{i}_sub_ids", f"ing{i}_sub_codes",
+                        f"ing{i}_lens", f"ing{i}_nleaf"}
+            missing = req_keys - set(z)
+            if missing:
+                raise RuntimeError(
+                    f"tree_restore: checkpoint at {path} is missing ingest "
+                    f"fields {sorted(missing)} (truncated write?)"
+                )
+            meta = np.array(z[f"ing{i}_meta"], np.int64)
+            if meta.shape != (11,) or int(meta[0]) != int(w):
+                raise RuntimeError(
+                    f"tree_restore: checkpoint at {path} has a malformed "
+                    f"ingest meta row for window {int(w)}"
+                )
+            lens = np.array(z[f"ing{i}_lens"], np.int64)
+            n_leaf = int(z[f"ing{i}_nleaf"])
+            if lens.shape[0] != int(meta[6]):
+                raise RuntimeError(
+                    f"tree_restore: ingest window {int(w)} entry table is "
+                    f"torn ({lens.shape[0]} lengths vs {int(meta[6])} slots)"
+                )
+            leaves = []
+            for j in range(n_leaf):
+                key = f"ing{i}_leaf{j}"
+                if key not in z:
+                    raise RuntimeError(
+                        f"tree_restore: ingest window {int(w)} is missing "
+                        f"leaf {j} (truncated write?)"
+                    )
+                leaf = z[key]  # npz entries are host ndarrays
+                if leaf.shape[0] != int(lens.sum()):
+                    raise RuntimeError(
+                        f"tree_restore: ingest window {int(w)} leaf {j} "
+                        f"covers {leaf.shape[0]} keys, lengths sum to "
+                        f"{int(lens.sum())}"
+                    )
+                leaves.append(leaf)
+            sub_ids = z[f"ing{i}_sub_ids"]
+            codes = np.array(z[f"ing{i}_sub_codes"], np.int64)
+            if sub_ids.shape[0] != codes.shape[0]:
+                raise RuntimeError(
+                    f"tree_restore: ingest window {int(w)} verdict table "
+                    "is torn"
+                )
+            parsed.append({
+                "meta": meta,
+                "lens": lens,
+                "leaves": leaves,
+                "sub_ids": sub_ids,
+                "codes": codes,
+                "clients": np.array(z.get(f"ing{i}_clients", [])),
+                "client_keys": np.array(
+                    z.get(f"ing{i}_client_keys", []), np.int64
+                ),
+                "res": (
+                    np.array(z[f"ing{i}_res"], np.uint64)
+                    if f"ing{i}_res" in z
+                    else None
+                ),
+            })
+        return parsed
+
+    def _ingest_restore_apply(self, parsed: list) -> None:
+        """Rebuild the ingest pools from validated records (the mutation
+        half of the restore contract)."""
+        from ..native import Reservoir
+
+        self._ingest_pools.clear()
+        for rec in parsed:
+            meta = rec["meta"]
+            w = int(meta[0])
+            wa = self._admission.window(w)
+            pool = _WindowPool(w, wa)
+            pool.sealed = bool(meta[1])
+            pool.keys = int(meta[2])
+            pool.admitted_keys = int(meta[3])
+            pool.shed_keys = int(meta[4])
+            pool.rejected = int(meta[5])
+            wa.subs = int(meta[7])
+            wa.keys = int(meta[8])
+            wa.sub_keys = None if int(meta[9]) < 0 else int(meta[9])
+            wa.pending_draws = int(meta[10])
+            bounds = np.concatenate([[0], np.cumsum(rec["lens"])])
+            pool.entries = [
+                tuple(
+                    leaf[bounds[e]:bounds[e + 1]] for leaf in rec["leaves"]
+                )
+                for e in range(len(rec["lens"]))
+            ]
+            for sid, code in zip(rec["sub_ids"], rec["codes"]):
+                code = int(code)
+                if code == self._ING_SHED:
+                    resp = {"admitted": False, "shed": True, "window": w}
+                elif code == self._ING_APPEND:
+                    resp = {"admitted": True, "slot": None, "window": w}
+                else:
+                    resp = {"admitted": True, "slot": code, "window": w}
+                pool.verdicts[str(sid)] = resp
+            wa.client_keys = {
+                str(c): int(n)
+                for c, n in zip(rec["clients"], rec["client_keys"])
+            }
+            if rec["res"] is not None:
+                wa.reservoir = Reservoir.from_state(rec["res"])
+            self._ingest_pools[w] = pool
+
     async def tree_restore(self, req) -> dict:
         """Reload the :meth:`tree_checkpoint` for the level the leader
         names; returns the completed level so the leader re-runs from
@@ -1244,17 +1787,18 @@ class CollectorServer:
         Every validation runs BEFORE any state mutates: a mismatched
         fingerprint, a truncated/corrupt npz, or a blob from a deeper
         level than this key batch's tree must fail loudly and leave the
-        server's live state exactly as it was."""
+        server's live state exactly as it was.
+
+        Streaming ingest pools restore alongside (``ing_*`` fields, same
+        validate-before-mutate contract); an ingest-ONLY blob (written
+        between windows, no frontier) restores just the pools and leaves
+        the crawl state empty — ``window_load`` rebuilds it."""
         if self.ckpt_dir is None:
             raise RuntimeError("tree_restore: no checkpoint dir configured")
         want_level = int(req["level"])
         path = self._ckpt_path(want_level)
         if not os.path.exists(path):
             raise RuntimeError(f"tree_restore: no checkpoint at {path}")
-        if self.keys is None:
-            if not self.keys_parts:
-                raise RuntimeError("tree_restore before add_keys")
-            self._concat_keys()
         try:
             with np.load(path) as npz:
                 z = {k: npz[k] for k in npz.files}
@@ -1266,6 +1810,34 @@ class CollectorServer:
                 f"tree_restore: corrupt or truncated checkpoint at {path} "
                 f"({type(e).__name__}: {e})"
             ) from e
+        if "ing_only" in z and bool(z["ing_only"]):
+            # ingest-only blob: pools back, crawl state untouched-empty.
+            # No key requirement — the keys ARE the pools.
+            if int(z.get("level", want_level)) != want_level:
+                raise RuntimeError(
+                    f"tree_restore: checkpoint at {path} is stamped level "
+                    f"{want_level} but records level {int(z['level'])} "
+                    "(renamed or tampered file)"
+                )
+            parsed = self._ingest_validate(z, path)
+            if parsed is None:
+                raise RuntimeError(
+                    f"tree_restore: ingest-only checkpoint at {path} "
+                    "carries no ingest pools (truncated write?)"
+                )
+            self._ingest_restore_apply(parsed)
+            self.obs.count("checkpoint_restores", level=want_level)
+            obs.emit(
+                "resilience.server_restore",
+                server=self.server_id,
+                level=want_level,
+                ingest_only=True,
+            )
+            return {"level": want_level}
+        if self.keys is None:
+            if not self.keys_parts:
+                raise RuntimeError("tree_restore before add_keys")
+            self._concat_keys()
         required = {"seed", "bit", "y_bit", "alive", "alive_keys", "level",
                     "planar", "keys_fp"}
         missing = required - set(z)
@@ -1319,6 +1891,9 @@ class CollectorServer:
                     f"tree_restore: checkpoint at {path} is missing sketch "
                     f"fields {sorted(sk_missing)} (truncated write?)"
                 )
+        # ingest pools validate with everything else (a torn ing_* tail
+        # refuses before ANY state mutates); None = pre-streaming blob
+        parsed_ing = self._ingest_validate(z, path)
         # -- all checks passed: mutate ------------------------------------
         states = EvalState(
             seed=jax.device_put(z["seed"]),
@@ -1365,6 +1940,8 @@ class CollectorServer:
             else:
                 self._sketch_pairs = None
                 self._sketch_pairs_field = None
+        if parsed_ing is not None:
+            self._ingest_restore_apply(parsed_ing)
         self.obs.count("checkpoint_restores", level=level)
         obs.emit(
             "resilience.server_restore", server=self.server_id, level=level
@@ -1503,6 +2080,10 @@ class CollectorServer:
         "tree_prune_last",
         "final_shares",
         "sketch_verify",  # the TreeSketchFrontier* verbs' live successor
+        # streaming ingest front door (ROADMAP "Streaming ingestion")
+        "submit_keys",
+        "window_seal",
+        "window_load",
         # resilience verbs (no reference analogue)
         "status",
         "tree_checkpoint",
@@ -1567,11 +2148,15 @@ class CollectorServer:
                 asyncio.get_event_loop().create_future()
             )
         try:
-            if verb in ("add_keys", "plane_break"):
-                # add_keys: append-only, no awaits -> atomic.  plane_break
-                # MUST bypass the lock: it exists to break a verb wedged
-                # on the data plane while HOLDING the lock (pipelined
-                # quiesce) — behind the lock it could never run.
+            if verb in ("add_keys", "submit_keys", "plane_break"):
+                # add_keys/submit_keys: append-only, no awaits -> atomic;
+                # submit_keys MUST bypass the lock so ingest keeps
+                # flowing while a windowed crawl holds it (that
+                # concurrency is the whole point of the front door).
+                # plane_break MUST bypass it too: it exists to break a
+                # verb wedged on the data plane while HOLDING the lock
+                # (pipelined quiesce) — behind the lock it could never
+                # run.
                 resp = await getattr(self, verb)(req)
             else:
                 # frame-arrival expand stage: overlap a sharded crawl's

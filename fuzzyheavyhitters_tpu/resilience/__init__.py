@@ -11,17 +11,30 @@ plane (server↔server socket), and the leader's crawl supervision:
   to live in protocol/rpc.py.
 - :mod:`.chaos` — a frame-aware fault-injection proxy for recovery
   tests: sits between leader↔server or server↔server sockets and
-  severs, delays, black-holes, or truncates frames on a deterministic
-  ``FHH_FAULTS`` schedule (grammar in :func:`chaos.parse_faults`).
+  severs, delays, black-holes, truncates, floods (duplicate delivery),
+  or slow-trickles frames on a deterministic ``FHH_FAULTS`` schedule
+  (grammar in :func:`chaos.parse_faults`).
+- :mod:`.admission` — overload control for the streaming ingest front
+  door: token-bucket rate limits, per-client window quotas, bounded
+  pools, and the reject-vs-reservoir shed policies behind
+  protocol/rpc.py's ``submit_keys`` verb.
 - the reconnecting client + idempotent verb replay live in
   protocol/rpc.py itself (they ARE the transport), built on this
   module's policy vocabulary; leader-side crawl supervision lives in
-  protocol/leader_rpc.py (:meth:`RpcLeader.run_supervised`).
+  protocol/leader_rpc.py (:meth:`RpcLeader.run_supervised`) and the
+  windowed ingest driver beside it (:class:`WindowedIngest`).
 
 Every recovery event emits ``resilience.*`` telemetry: retry counts,
 reconnect epochs, replayed verbs, restored/re-run levels.
 """
 
+from .admission import (
+    AdmissionController,
+    ManualClock,
+    TokenBucket,
+    Verdict,
+    WindowAdmission,
+)
 from .chaos import ChaosProxy, FaultSpec, parse_faults
 from .policy import (
     Deadline,
@@ -32,11 +45,16 @@ from .policy import (
 )
 
 __all__ = [
+    "AdmissionController",
     "ChaosProxy",
     "Deadline",
     "FaultSpec",
+    "ManualClock",
     "RetryPolicy",
+    "TokenBucket",
+    "Verdict",
     "VerbBudgets",
+    "WindowAdmission",
     "is_transient",
     "parse_faults",
     "retry_async",

@@ -11,11 +11,13 @@ Fault grammar (the ``FHH_FAULTS`` env spec; ';'-separated clauses)::
     <link>:<action>@msg=<N>[,key=value...]
 
     link    label the proxy was constructed with (e.g. ctl0, ctl1, plane)
-    action  sever | delay | blackhole | truncate
+    action  sever | delay | blackhole | truncate | flood | slowclient
     msg=N   fire when the Nth frame (1-indexed, per direction) arrives
     dir=    c2s (default) | s2c — which direction's frame counter triggers
-    ms=M    delay: forward the frame M milliseconds late (default 200)
-    count=K blackhole: drop K consecutive frames then resume (default 1;
+    ms=M    delay/slowclient: forward M milliseconds late (default 200)
+    count=K blackhole: drop K consecutive frames then resume;
+            flood: deliver K EXTRA copies of the trigger frame;
+            slowclient: trickle K consecutive frames (default 1;
             sever/truncate ignore it — the connection is gone after one)
 
 Actions:
@@ -31,6 +33,13 @@ Actions:
 - ``truncate``  — forward only half of the frame's payload bytes, then
   sever (tests the torn-frame path: the reader must classify the
   corrupt/short frame as transport loss, not crash).
+- ``flood``     — deliver the trigger frame 1 + ``count`` times (the
+  at-least-once delivery pathology made real: a duplicated
+  ``submit_keys``/verb frame must be absorbed by the replay dedup /
+  recorded-verdict machinery, never double-applied).
+- ``slowclient`` — trickle the next ``count`` frames ``ms`` late EACH
+  (a slow or throttled client; tests that a slow producer stalls only
+  itself — the crawl and other clients keep moving).
 
 Each accepted connection gets an independent pump per direction.  Frame
 ORDINALS are per connection and per direction (deterministic: TCP orders
@@ -52,7 +61,7 @@ from .. import obs
 
 _HDR = struct.Struct("<Q")  # mirror protocol/rpc.py framing
 
-_ACTIONS = ("sever", "delay", "blackhole", "truncate")
+_ACTIONS = ("sever", "delay", "blackhole", "truncate", "flood", "slowclient")
 _DIRS = ("c2s", "s2c")
 
 
@@ -135,8 +144,10 @@ class ChaosProxy:
         self._conns: set[tuple] = set()
         self._pumps: set[asyncio.Task] = set()
         # armed faults are consumed proxy-globally: [spec, remaining_fires]
+        # (blackhole/slowclient fire once per frame for count frames; the
+        # rest fire once — flood's count multiplies within its one fire)
         self._armed: list[list] = [
-            [f, f.count if f.action == "blackhole" else 1]
+            [f, f.count if f.action in ("blackhole", "slowclient") else 1]
             for f in self.faults
         ]
         self.frames = {"c2s": 0, "s2c": 0}  # lifetime totals, all conns
@@ -235,8 +246,15 @@ class ChaosProxy:
                         await writer.drain()
                         self._sever_pair(pair)
                         return
-                    if fault.action == "delay":
+                    if fault.action in ("delay", "slowclient"):
                         await asyncio.sleep(fault.ms / 1000.0)
+                    if fault.action == "flood":
+                        # duplicate delivery: the frame arrives count
+                        # EXTRA times (at-least-once made real) — the
+                        # original forward below is the +1
+                        for _ in range(max(1, fault.count)):
+                            writer.write(hdr + body)
+                        await writer.drain()
                 writer.write(hdr + body)
                 await writer.drain()
         except (ConnectionError, asyncio.IncompleteReadError, OSError):

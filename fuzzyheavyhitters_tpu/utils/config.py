@@ -93,6 +93,31 @@ class Config:
     # measure dispatch only); the phases are sequential data-dependent
     # steps, so the syncs cost only the dispatch-ahead slack.
     secure_phase_sync: bool = True
+    # -- streaming ingest front door (protocol/rpc.py submit_keys,
+    #    resilience/admission.py) -------------------------------------
+    # hard per-window pool bound in KEYS — the "no unbounded queue"
+    # invariant made a number; over it the shed policy below decides
+    ingest_window_keys: int = 1 << 20
+    # keys/sec token-bucket rate limit at the gate server (0 = off) and
+    # its burst allowance; an over-rate submission gets a retryable
+    # Overloaded verdict carrying the refill horizon
+    ingest_rate_keys_per_s: float = 0.0
+    ingest_burst_keys: int = 4096
+    # per-client keys-per-window quota (0 = off): a flooding client hits
+    # its quota and backs off; other clients' admissions are unaffected
+    ingest_client_quota: int = 0
+    # over-capacity behavior: "reject" answers Overloaded (the client
+    # backs off and retries); "reservoir" keeps the pool a seeded
+    # uniform sample of everything offered (native.Reservoir — seed-
+    # reproducible, checkpoint-carried)
+    ingest_shed: str = "reject"
+    # seed for the reservoir shed sampler (per-window streams derive
+    # from it deterministically)
+    ingest_seed: int = 0
+    # how many ingest windows a server keeps live at once (the sealed
+    # window being crawled + the window(s) still accruing); bounds
+    # server memory against a runaway window id
+    ingest_windows_retained: int = 4
 
 
 def load_config(path: str) -> Config:

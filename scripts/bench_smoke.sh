@@ -53,12 +53,18 @@ assert "ot_path" in sk and all(
     "secure_kernel phase split (phase_otext/garble/eval/b2a + ot_path) "
     "missing from the compact line: " + last[:300]
 )
+ing = doc.get("extra", {}).get("ingest", {})
+assert "ingest_keys_per_sec" in ing and ing.get("bit_identical_vs_batch"), (
+    "ingest section (streaming front door: keys/sec + batch bit-identity) "
+    "missing from the compact line: " + last[:300]
+)
 print(
     "bench_smoke OK: "
     f"{doc['metric']}={doc['value']}, "
     f"secure_clients_per_sec={sc['secure_clients_per_sec']}, "
     f"ot_path={sk['ot_path']}, "
     f"pipeline_speedup={sc.get('pipeline_speedup')}, "
+    f"ingest_keys_per_sec={ing['ingest_keys_per_sec']}, "
     f"line={len(last)}B, elapsed={doc.get('budget', {}).get('elapsed_s')}s"
 )
 EOF

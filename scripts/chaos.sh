@@ -5,7 +5,9 @@
 #   - runs the full fault-injection/recovery surface on the CPU backend:
 #     the socket-path suite (tests/test_resilience.py — control/data
 #     plane chaos, sketch recovery via the challenge ratchet, sharded
-#     mid-level retry) AND the mesh/ICI suite (tests/test_mesh_chaos.py),
+#     mid-level retry), the mesh/ICI suite (tests/test_mesh_chaos.py),
+#     AND the streaming-ingest suite (tests/test_ingest.py — admission
+#     control, flood/slowclient chaos, kill-mid-window recovery),
 #     INCLUDING the slow-marked multi-fault storm tier-1 skips
 #   - writes a JSON artifact ({passed, failed, duration_s, tests}) to $1
 #     (default: chaos_report.json); exits non-zero on any failure
@@ -22,7 +24,8 @@ artifact="${1:-chaos_report.json}"
 report="$(mktemp)"
 
 JAX_PLATFORMS=cpu python -m pytest \
-    tests/test_resilience.py tests/test_mesh_chaos.py -m "" -q \
+    tests/test_resilience.py tests/test_mesh_chaos.py tests/test_ingest.py \
+    -m "" -q \
     -p no:cacheprovider --junitxml="$report"
 rc=$?
 

@@ -432,6 +432,66 @@ def test_unbounded_await_suppression():
 
 
 # ---------------------------------------------------------------------------
+# rule: unbounded-queue
+# ---------------------------------------------------------------------------
+
+
+def test_unbounded_queue_detected():
+    """The exact bug class the streaming front door exists to prevent:
+    a buffer with no bound between a producer and a slower consumer."""
+    src = """
+    import asyncio
+    import collections
+
+    q = asyncio.Queue()
+    d = collections.deque()
+    s = queue.SimpleQueue()
+    zero = asyncio.Queue(maxsize=0)
+    none = collections.deque(maxlen=None)
+    """
+    fs = _lint(src, rule="unbounded-queue")
+    assert len(fs) == 5
+    assert all(f.rule == "unbounded-queue" for f in fs)
+
+
+def test_unbounded_queue_bounded_forms_clean():
+    src = """
+    import asyncio
+    import collections
+
+    q = asyncio.Queue(maxsize=64)
+    qpos = asyncio.Queue(64)
+    d = collections.deque(maxlen=8)
+    dpos = collections.deque([], 8)
+    dyn = asyncio.Queue(maxsize=cap)
+    """
+    assert _lint(src, rule="unbounded-queue") == []
+
+
+def test_unbounded_queue_scoped_and_suppressible():
+    src = """
+    import collections
+    d = collections.deque()
+    """
+    assert len(_lint(src, rule="unbounded-queue")) == 1
+    assert _lint(
+        src, "fuzzyheavyhitters_tpu/resilience/fake.py",
+        rule="unbounded-queue",
+    )
+    assert _lint(
+        src, "fuzzyheavyhitters_tpu/ops/fake.py", rule="unbounded-queue"
+    ) == []
+    assert _lint(src, "tests/test_x.py", rule="unbounded-queue") == []
+    sup = """
+    import collections
+    # fhh-lint: disable=unbounded-queue (bounded by construction: the
+    # refill loop never holds more than `depth` entries)
+    d = collections.deque()
+    """
+    assert _lint(sup, rule="unbounded-queue") == []
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -642,7 +702,7 @@ def test_pyproject_and_dataclass_defaults_do_not_drift():
     for key in (
         "hot_modules", "hot_roots", "secret_lexicon", "sink_calls",
         "print_scope", "print_allowed", "shared_state_modules",
-        "await_modules", "default_paths", "baseline",
+        "await_modules", "queue_modules", "default_paths", "baseline",
     ):
         assert getattr(operative, key) == getattr(defaults, key), key
 
@@ -769,6 +829,7 @@ def test_every_rule_has_fixture_coverage():
         "bare-print",
         "chunked-device-readback",
         "unbounded-await",
+        "unbounded-queue",
     }
     assert {r.name for r in ALL_RULES} == covered
 

@@ -348,6 +348,17 @@ def test_bench_budget_and_compact_line(monkeypatch):
         "crawl_hbm_max": {"skipped": "budget"},
         "covid": {"error": "timeout after 540s", "partial_thing": 1},
         "upload": {"upload_keys_per_sec": 3e5, "n_keys": 10**6},
+        "ingest": {
+            "ingest_keys_per_sec": 150000.0,
+            "concurrent_keys_per_sec": 90000.0,
+            "windows": 2,
+            "shed": 0,
+            "rejected": 3,
+            "bit_identical_vs_batch": True,
+            "report_ingest": {"admitted": 65536, "keys_per_sec": 150000.0},
+            "window_crawl_seconds": 4.2,
+            "n_keys": 65536,
+        },
     }
     compact = bench._compact_extra(extra)
     assert "keygen_sweep" not in compact
@@ -360,6 +371,10 @@ def test_bench_budget_and_compact_line(monkeypatch):
     assert compact["crawl_hbm_max"] == {"skipped": "budget"}
     assert compact["covid"] == {"error": "timeout after 540s"}
     assert compact["upload"] == {"upload_keys_per_sec": 3e5}
+    # the streaming front-door section rides the line, scalars only
+    assert compact["ingest"]["ingest_keys_per_sec"] == 150000.0
+    assert compact["ingest"]["bit_identical_vs_batch"] is True
+    assert "report_ingest" not in compact["ingest"]
     # the compact line stays far under the harness's stdout tail capture
     import json
 
