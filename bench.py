@@ -25,6 +25,11 @@ throughput vs the reference's single-threaded AES-NI baseline
 - ``secure_device``: the whole per-level 2PC as one on-chip program at
   flagship shape (>= 65k clients, L >= 64, plus an L=512-key level) —
   the 1-chip stand-in for the 2-chip mesh deployment;
+- ``multichip``: secure clients/sec with each collector server's client
+  axis sharded over 1/2/4/8 local data devices
+  (``Config.server_data_devices``, parallel/server_mesh.py), every leg
+  gated on bit-identity vs the single-device leg, with the pre-wire ICI
+  reduction's seconds on the compact line;
 - ``hbm``: the 1M-client HBM plan VALIDATED by allocation — the L=512
   key batch at the largest bench N actually lives on the chip, 3 levels
   run, and bytes/client are measured, not derived;
@@ -797,6 +802,105 @@ def bench_secure(n=1024, L=12, port=39831, shard_nodes=4, pipeline_depth=4):
     }
 
 
+def bench_multichip(n=1024, L=12, port=40231, shards=(1, 2, 4, 8),
+                    f_max=64):
+    """Multi-chip collector servers: secure clients/sec as each server's
+    client axis shards over 1/2/4/8 LOCAL data devices
+    (parallel/server_mesh.py — ``Config.server_data_devices``).  Every
+    sharded leg is asserted BIT-IDENTICAL to the single-device leg
+    before any number is reported (sharding is a physical layout; the
+    2PC transcript never changes), and the highest-shard leg's
+    ``ici_reduce_seconds`` (the pre-wire psum, fetch-synced) rides the
+    compact line next to ``data_shards``.  Shard counts beyond the
+    visible device count (or not dividing the client batch) are
+    reported as skipped, not silently dropped — on a CPU host run under
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (the smoke
+    path) all four legs run."""
+    import asyncio
+    import jax
+
+    from fuzzyheavyhitters_tpu.ops import ibdcf
+    from fuzzyheavyhitters_tpu.parallel import server_mesh
+    from fuzzyheavyhitters_tpu.utils.config import Config
+
+    rng = np.random.default_rng(5)
+    sites = rng.integers(0, 1 << L, size=8)
+    pts = sites[rng.integers(0, 8, size=n)]
+    pts_bits = (
+        ((pts[:, None, None] >> np.arange(L - 1, -1, -1)) & 1) > 0
+    )
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng, engine=_keygen_engine())
+
+    def leg_cfg(p, k):
+        return Config(
+            data_len=L, n_dims=1, ball_size=2, addkey_batch_size=1024,
+            num_sites=8, threshold=0.05, zipf_exponent=1.03,
+            server0=f"127.0.0.1:{p}", server1=f"127.0.0.1:{p + 10}",
+            distribution="zipf", f_max=f_max, secure_exchange=True,
+            server_data_devices=k,
+        )
+
+    n_devices = len(jax.devices())
+
+    async def one_leg(k, p):
+        cfg = leg_cfg(p, k)
+        lead, c0, c1, s0, s1 = await _bring_up_pair(cfg, p)
+        try:
+            await lead.upload_keys(k0, k1)
+            await lead.warmup()  # sharded program ladder, off the clock
+            res = await lead.run(n)  # warm residual trace/dispatch cost
+            await lead._both("reset")
+            await lead.upload_keys(k0, k1)
+            t = time.perf_counter()
+            res = await lead.run(n)
+            dt = time.perf_counter() - t
+            ici = (
+                s0.obs.timer_seconds("ici_reduce")
+                + s1.obs.timer_seconds("ici_reduce")
+            )
+            st = await c0.call("status")
+            return res, dt, ici, st.get("mesh")
+        finally:
+            for c in (c0, c1):
+                await c.aclose()
+            for s in (s0, s1):
+                await s.aclose()
+
+    rates: dict = {}
+    skipped: dict = {}
+    base_res = None
+    top = (1, 0.0, None)  # (shards, ici_reduce_s, mesh status)
+    for i, k in enumerate(shards):
+        if k > n_devices:
+            skipped[str(k)] = "devices"
+            continue
+        if server_mesh._largest_divisor_leq(n, k) != k:
+            skipped[str(k)] = "batch"
+            continue
+        res, dt, ici, mesh_st = asyncio.run(one_leg(k, port + 40 * i))
+        rates[str(k)] = round(n / dt, 1)
+        if base_res is None:
+            base_res = res
+        else:
+            # gate: a sharded leg that is not bit-identical to the
+            # single-device leg reports nothing
+            assert np.array_equal(base_res.counts, res.counts)
+            assert np.array_equal(base_res.paths, res.paths)
+        if k >= top[0]:
+            top = (k, ici, mesh_st)
+    return {
+        "bit_identical": base_res is not None and len(rates) > 1,
+        "data_shards": top[0],
+        "ici_reduce_seconds": round(top[1], 3),
+        "secure_clients_per_sec": rates,
+        "skipped_shards": skipped,
+        "n_clients": n,
+        "data_len": L,
+        "n_devices": n_devices,
+        "mesh_status": top[2],
+    }
+
+
 def bench_secure_device(n=65536, L=64, f_bucket=4, with_l512=True):
     """Device-resident secure-crawl measurement at FLAGSHIP shape: the
     WHOLE per-level 2PC — both parties' expand, label extension, garbling,
@@ -1553,6 +1657,10 @@ _COMPACT_KEYS = {
         "ingest_keys_per_sec", "concurrent_keys_per_sec", "windows",
         "shed", "rejected", "bit_identical_vs_batch",
     ),
+    "multichip": (
+        "secure_clients_per_sec", "data_shards", "ici_reduce_seconds",
+        "bit_identical",
+    ),
 }
 
 
@@ -1634,6 +1742,21 @@ def main():
             " pipeline_depth=3)))"
         ),
     )
+    multichip = section(
+        "multichip",
+        "import json, bench;print(json.dumps(bench.bench_multichip()))",
+        # four warmed legs (1/2/4/8 data shards), each its own server
+        # pair with its own sharded program ladder
+        timeout_s=720,
+        # f_max=32 trims one warmup-ladder rung per leg per field
+        # (the zipf smoke frontier peaks at 28 survivors) — the smoke
+        # budget must leave room for the ingest section after this
+        smoke_code=(
+            "import json, bench;"
+            "print(json.dumps(bench.bench_multichip(n=64, L=6,"
+            " shards=(1, 2, 4), f_max=32)))"
+        ),
+    )
     secure_device = section(
         "secure_device",
         "import json, bench;print(json.dumps(bench.bench_secure_device()))",
@@ -1690,7 +1813,11 @@ def main():
         timeout_s=2700,
     )
     try:
-        write_keygen_csv(sweep)
+        # smoke mode must not clobber the tracked chip reference rows
+        # with its tiny np-engine sweep (the CSV is the cross-round
+        # keygen continuity artifact)
+        if not BENCH_SMOKE:
+            write_keygen_csv(sweep)
     except Exception:
         pass
 
@@ -1700,6 +1827,7 @@ def main():
         "crawl": crawl,
         "crawl_hbm_max": crawl_hbm_max,
         "secure_crawl": secure,
+        "multichip": multichip,
         "secure_device": secure_device,
         "hbm": hbm,
         "covid": covid,

@@ -45,6 +45,8 @@ class LintConfig:
         "expand_share_bits_from_cw",
         "advance_from_children",
         "advance_from_cw",
+        # the rpc expand stage: frame-arrival dispatch, per-level work
+        "_maybe_pre_expand",
     )
     # secret-to-sink rule: identifier segments naming key material (split
     # on "_"; an identifier matches when any segment is in the lexicon)
@@ -89,10 +91,15 @@ class LintConfig:
     # chunked-device-readback rule: secure-kernel hot roots where a loop
     # of per-chunk device readbacks (incl. the sanctioned _fetch helper)
     # must never grow back — the whole-level batching this repo's
-    # secure path rests on
+    # secure path rests on.  parallel/ (both mesh paths: a readback loop
+    # there fetches once per SHARD) and protocol/rpc.py (the crawl
+    # verbs' expand/open stages) joined the scope with the multi-chip
+    # refactor.
     readback_modules: tuple = (
         "fuzzyheavyhitters_tpu/protocol/secure.py",
+        "fuzzyheavyhitters_tpu/protocol/rpc.py",
         "fuzzyheavyhitters_tpu/ops",
+        "fuzzyheavyhitters_tpu/parallel",
     )
     # unbounded-queue rule: ingest/transport modules where every
     # producer/consumer buffer (asyncio.Queue, deque) must carry a

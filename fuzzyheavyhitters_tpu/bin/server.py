@@ -64,7 +64,23 @@ async def amain(cfg, server_id: int) -> None:
         ckpt_dir = os.environ.get("FHH_CKPT_DIR") or None
         if ckpt_dir is not None:
             os.makedirs(ckpt_dir, exist_ok=True)
-        server = CollectorServer(server_id, cfg, ckpt_dir=ckpt_dir)
+        # multi-chip client sharding: FHH_DATA_DEVICES overrides the
+        # config knob (0 = auto: all local devices on an accelerator
+        # host), and FHH_MESH_FAULTS arms the consumed-once device-loss
+        # schedule (resilience.chaos mesh grammar: mesh:kill@level=N ...)
+        # for recovery drills against a live server
+        dd = os.environ.get("FHH_DATA_DEVICES")
+        if dd is not None:
+            cfg.server_data_devices = int(dd)
+        mesh_chaos = None
+        faults = os.environ.get("FHH_MESH_FAULTS")
+        if faults:
+            from ..resilience.chaos import MeshChaos, parse_mesh_faults
+
+            mesh_chaos = MeshChaos(parse_mesh_faults(faults))
+        server = CollectorServer(
+            server_id, cfg, ckpt_dir=ckpt_dir, _mesh_chaos=mesh_chaos
+        )
         srv = await server.start(my_host, my_port, peer_host, peer_port)
         obs.emit("server.serving", server=server_id, host=my_host, port=my_port)
         async with srv:

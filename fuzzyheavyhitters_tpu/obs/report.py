@@ -47,6 +47,11 @@ Well-known metric names (what populates them):
   (``{count, levels_rerun, shards_rerun, dedup_hits, dedup_hit_rate}``)
   whenever any supervised component ran, so a recovered run is
   distinguishable from a fault-free one in the report alone.
+- gauge ``data_shards`` + phase ``ici_reduce`` + counters
+  ``mesh_reshards`` / ``mesh_faults`` (multi-chip servers,
+  parallel/server_mesh.py: client-axis shard count, the pre-wire ICI
+  psum's fetch-synced seconds, and device-loss recovery events) — rolled
+  up into a top-level ``mesh`` section whenever a multi-chip crawl ran.
 - counters ``ingest_admitted`` / ``ingest_shed`` / ``ingest_rejected`` /
   ``ingest_windows`` + phases ``ingest`` / ``window_crawl`` (the
   windowed front-door driver's dedicated registry,
@@ -122,6 +127,9 @@ def run_report(registries=None) -> dict:
     ing = _ingest_summary(out)
     if ing is not None:
         doc["ingest"] = ing
+    mesh = _mesh_summary(out)
+    if mesh is not None:
+        doc["mesh"] = mesh
     if dropped:
         doc["dropped_registries"] = dropped
     return doc
@@ -311,6 +319,61 @@ def _ingest_summary(registries: dict) -> dict | None:
             sums["ingest_admitted"] / ingest_s, 2
         ) if ingest_s > 0 else None,
         "window_crawl_seconds": round(crawl_s, 6),
+    }
+
+
+def _mesh_summary(registries: dict) -> dict | None:
+    """Cross-registry multi-chip rollup (per-server client sharding,
+    parallel/server_mesh.py): the shard count the crawl ran at
+    (``data_shards`` gauge, per level), total + per-level
+    ``ici_reduce_seconds`` (the pre-wire psum's cost instrument — fetch-
+    synced, so these are real seconds), and the device-loss recovery
+    counters (``mesh_reshards`` — frontier re-placed from a host-side
+    checkpoint; ``mesh_faults`` — every injected/detected mesh fault).
+    Present only when a multi-chip crawl ran — single-device servers
+    never emit these metrics."""
+    shards_last = None
+    shards_by: dict = {}
+    ici_total = 0.0
+    ici_by: dict = {}
+    reshards = faults = 0
+    seen = False
+    for snap in registries.values():
+        g = snap.get("gauges", {}).get("data_shards")
+        if g is not None:
+            seen = True
+            shards_last = g.get("last")
+            shards_by.update(g.get("by_level", {}))
+        t = snap.get("phases", {}).get("ici_reduce")
+        if t is not None:
+            seen = True
+            ici_total += t.get("seconds", 0.0)
+            for lvl, s in t.get("by_level", {}).items():
+                ici_by[lvl] = ici_by.get(lvl, 0.0) + s
+        for name in ("mesh_reshards", "mesh_faults"):
+            c = snap.get("counters", {}).get(name)
+            if c is None:
+                continue
+            seen = True
+            if name == "mesh_reshards":
+                reshards += c.get("total", 0)
+            else:
+                faults += c.get("total", 0)
+    if not seen:
+        return None
+    levels = sorted(set(shards_by) | set(ici_by), key=lambda k: int(k))
+    return {
+        "data_shards": shards_last,
+        "ici_reduce_seconds": round(ici_total, 6),
+        "reshards": reshards,
+        "faults": faults,
+        "by_level": {
+            lvl: {
+                "data_shards": shards_by.get(lvl),
+                "ici_reduce_seconds": round(ici_by.get(lvl, 0.0), 6),
+            }
+            for lvl in levels
+        },
     }
 
 

@@ -266,7 +266,7 @@ class MeshRunner:
             sec_seed = np.asarray(sec_seed)
         self._sec = {}
         for g, (s_bits, seeds0, seeds1, chosen) in enumerate(host_mats):
-            # fhh-lint: disable=host-sync-in-hot-loop (one-time session setup)
+            # fhh-lint: disable=host-sync-in-hot-loop,chunked-device-readback (one-time session setup)
             s_bits = np.asarray(s_bits)
             zb = np.zeros_like(s_bits)
             rows = lambda a_g, a_e: np.stack([a_g, a_e] if g == 0 else [a_e, a_g])

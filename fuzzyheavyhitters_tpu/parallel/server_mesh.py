@@ -1,0 +1,236 @@
+"""Per-server multi-chip execution: the client axis sharded over a
+LOCAL 1-D device mesh.
+
+parallel/mesh.py is the WHOLE-PROTOCOL mesh (2-D ``{'servers': 2,
+'data': k}``: both parties on one runtime, the inter-party exchange as
+``ppermute`` collectives) — a single trust domain.  This module is the
+production complement for the two-administrative-domain deployment
+(protocol/rpc.py sockets): each :class:`CollectorServer` keeps its OWN
+pjit mesh over its OWN chips and shards only the client axis across
+them.  The paper's crawl cost is linear in clients per level (every
+frontier node evaluates every client's ibDCF state, PAPER.md §0), and
+the client axis is embarrassingly parallel until the per-node count
+reduction — so:
+
+- client key planes, ibDCF eval states, and the per-level FSS expansion
+  shard along the client axis with ``NamedSharding`` (the existing jit
+  programs partition under GSPMD — every op here is exact integer math,
+  so the sharded programs are BIT-identical to the single-device ones);
+- per-node partial count / field-share sums reduce across the local
+  ``data`` axis over ICI (``shard_map`` bodies reusing
+  :func:`parallel.mesh.field_psum`, the overflow-safe split-limb psum)
+  BEFORE anything is fetched — the wire then carries the same few-KB
+  per-level payloads as a single-device server;
+- the GC/OT wire stage is deliberately NOT resharded: the planar wire
+  message is the single-device layout by construction, so the 2PC
+  transcript (and with it the peer server and the leader) cannot tell a
+  sharded server from a single-device one.
+
+This is what turns "millions of users" from a throughput statement into
+a memory-capacity statement: per-chip HBM holds ``N / k`` clients'
+frontier state, and k grows with the server's chip count.
+
+Layout note: the mesh path pins the XLA expand engine (interleaved
+``[F, N, d, 2]`` frontier states — the client axis is a plain named
+axis; the planar Pallas engine's pallas_call does not take sharded
+operands), exactly like the 2-D mesh bodies do.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.ibdcf import EvalState
+from .mesh import _shard_map, field_psum
+
+DATA = "data"
+
+
+@lru_cache(maxsize=None)
+def _mesh_for(devices: tuple) -> Mesh:
+    """One Mesh object per device tuple, shared by every ServerMesh in
+    the process — the reduction kernels below are module-level jit
+    objects keyed on it, so two servers (or a warm run and a live run)
+    over the same devices share ONE compiled program per shape instead
+    of compiling per instance."""
+    return Mesh(np.asarray(devices), (DATA,))
+
+
+@lru_cache(maxsize=None)
+def _counts_fn(devices: tuple):
+    from ..protocol import collect
+
+    mesh = _mesh_for(devices)
+
+    def body(ps, pp, m, ak, an):
+        return jax.lax.psum(
+            collect.counts_by_pattern(ps, pp, m, ak, an), DATA
+        )
+
+    # fhh-lint: disable=recompile-churn (lru_cached factory: built once per device set)
+    return jax.jit(
+        _shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, DATA), P(None, DATA), P(), P(DATA), P()),
+            out_specs=P(),
+        )
+    )
+
+
+@lru_cache(maxsize=None)
+def _share_sums_fn(devices: tuple, field_name: str):
+    from ..ops.fields import F255, FE62
+    from ..protocol import secure
+
+    field = {"FE62": FE62, "F255": F255}[field_name]
+    mesh = _mesh_for(devices)
+
+    def body(v, w):
+        return field_psum(field, secure.node_share_sums(field, v, w), DATA)
+
+    # fhh-lint: disable=recompile-churn (lru_cached factory: built once per device set and field)
+    return jax.jit(
+        _shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, None, DATA), P(None, None, DATA)),
+            out_specs=P(),
+        )
+    )
+
+
+def resolve_data_devices(requested: int) -> int:
+    """How many local devices a server's mesh should span.
+
+    ``requested`` 0 = auto: all visible local devices on an accelerator
+    host, ONE on a CPU host (virtual host-platform devices exist for the
+    test/bench meshes; a production CPU server gains nothing from
+    sharding its own host).  Explicit requests are capped at the visible
+    device count."""
+    from ..utils import effective_platform
+
+    try:
+        avail = len(jax.local_devices())
+    except RuntimeError:  # no backend: single-device semantics
+        return 1
+    if requested <= 0:
+        return avail if effective_platform() != "cpu" else 1
+    return max(1, min(int(requested), avail))
+
+
+def _largest_divisor_leq(n: int, k: int) -> int:
+    """Largest divisor of ``n`` that is <= ``k`` (shard counts must tile
+    the client batch exactly — shard_map and the checkpoint re-shard
+    path both require it)."""
+    k = max(1, min(k, n)) if n > 0 else 1
+    while n % k:
+        k -= 1
+    return k
+
+
+class ServerMesh:
+    """One collector server's local data-parallel mesh.
+
+    Construct with the device budget (:func:`resolve_data_devices`),
+    then :meth:`bind` to a client batch — the ACTIVE shard count is the
+    largest divisor of the batch that fits the budget, so a prime-sized
+    batch degrades to fewer shards instead of failing.  All placement
+    helpers and the shard_map reduction kernels hang off the bound mesh;
+    re-binding (a new collection's batch) rebuilds them.
+    """
+
+    def __init__(self, n_devices: int):
+        self.devices = tuple(jax.local_devices()[: max(1, n_devices)])
+        self.n_devices = len(self.devices)
+        self.shards = 1
+        self.n_clients: int | None = None
+        self.mesh: Mesh | None = None
+
+    def bind(self, n_clients: int) -> "ServerMesh":
+        """Fix the active mesh for an ``n_clients`` batch."""
+        k = _largest_divisor_leq(n_clients, self.n_devices)
+        self.mesh = _mesh_for(self.devices[:k])
+        self.shards = k
+        self.n_clients = int(n_clients)
+        return self
+
+    def occupancy(self) -> list:
+        """Clients per shard (uniform by construction: the shard count
+        divides the batch)."""
+        if self.n_clients is None:
+            return []
+        return [self.n_clients // self.shards] * self.shards
+
+    # -- placement --------------------------------------------------------
+
+    def put(self, arr, spec: P):
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+    def shard_keys(self, keys):
+        """Key batch leaves ``[N, ...]`` -> client axis sharded."""
+        return jax.tree.map(lambda a: self.put(a, P(DATA)), keys)
+
+    def shard_frontier(self, frontier):
+        """Interleaved-layout frontier (states ``[F, N, d, 2, ...]``) ->
+        client axis sharded, alive mask replicated.  Used at tree_init
+        and checkpoint restore; mid-crawl the sharding propagates
+        through the jitted advance programs on its own."""
+        st = frontier.states
+        states = EvalState(
+            seed=self.put(st.seed, P(None, DATA)),
+            bit=self.put(st.bit, P(None, DATA)),
+            y_bit=self.put(st.y_bit, P(None, DATA)),
+        )
+        return frontier._replace(
+            states=states, alive=self.put(frontier.alive, P())
+        )
+
+    def gather(self, arr):
+        """Collapse a client-axis-sharded array back onto ONE device
+        (the mesh's first).  The GC/OT kernel stage is single-device by
+        design — the planar Pallas engines take no sharded operands, so
+        the packed share bits gather over ICI before string extraction
+        (sharding the kernel stage itself is the ROADMAP phase-2 item).
+        A pure layout move: values are untouched, bit-identity holds by
+        construction."""
+        return jax.device_put(arr, self.devices[0])
+
+    # -- ICI reductions (the pre-wire psum hooks) -------------------------
+
+    def _active_devices(self) -> tuple:
+        return self.devices[: self.shards]
+
+    def counts_by_pattern(self, packed_self, packed_peer, masks,
+                          alive_keys, alive_nodes):
+        """Trusted-mode per-node counts with the client-axis sum taken
+        PER SHARD and psum-reduced over ICI — the [F, 2^d] result is
+        replicated, so the wire/leader sees the single-device value
+        (uint32 sums are exact; order is irrelevant).
+
+        Inputs are eagerly placed to their canonical shardings first:
+        the executable cache keys on input shardings, and the producers
+        vary (jit outputs, wire numpy, warmup twins) — normalizing here
+        pins ONE compiled program per shape for warm and live alike."""
+        return _counts_fn(self._active_devices())(
+            self.put(packed_self, P(None, DATA)),
+            self.put(jnp.asarray(packed_peer), P(None, DATA)),
+            self.put(jnp.asarray(masks), P()),
+            self.put(jnp.asarray(alive_keys), P(DATA)),
+            self.put(alive_nodes, P()),
+        )
+
+    def node_share_sums(self, field, vals, weight):
+        """Secure-mode per-(node, pattern) share sums: per-shard
+        ``field.sum`` partials folded with the overflow-safe split-limb
+        :func:`parallel.mesh.field_psum` over the local ``data`` axis.
+        Bit-identical to the single-device ``secure.node_share_sums``
+        (both are the exact sum mod p in canonical form).  Inputs are
+        canonically placed first (see :meth:`counts_by_pattern`)."""
+        return _share_sums_fn(self._active_devices(), field.__name__)(
+            self.put(jnp.asarray(vals), P(None, None, DATA)),
+            self.put(jnp.asarray(weight), P(None, None, DATA)),
+        )

@@ -217,7 +217,8 @@ def pattern_masks(d: int) -> np.ndarray:
 
 
 def expand_share_bits(
-    keys: IbDcfKeyBatch, frontier: Frontier, level, want_children: bool = True
+    keys: IbDcfKeyBatch, frontier: Frontier, level, want_children: bool = True,
+    use_pallas: bool | None = None,
 ):
     """One PRG expansion of the whole frontier -> packed share bits + the
     both-direction child-state cache.
@@ -243,10 +244,16 @@ def expand_share_bits(
     ``want_children=False`` (the LAST level, which nothing advances past)
     skips materializing the cache — jit outputs are never dead-code
     eliminated, so the flag must be static, not a discarded return.
+
+    ``use_pallas`` overrides the process engine (None follows it):
+    callers that pin a frontier LAYOUT — the multi-chip server mesh pins
+    interleaved/XLA, parallel/server_mesh.py — must pin the engine to
+    match, exactly like ``tree_init``'s ``planar`` knob.
     """
+    if use_pallas is None:
+        use_pallas = _expand_engine()
     return _expand_share_bits_jit(
-        keys, frontier, level, prg.DERIVED_BITS, want_children,
-        _expand_engine(),
+        keys, frontier, level, prg.DERIVED_BITS, want_children, use_pallas,
     )
 
 
@@ -439,6 +446,7 @@ def advance(
     parent_idx: jax.Array,
     pattern_bits: jax.Array,
     n_alive: jax.Array,
+    use_pallas: bool | None = None,
 ) -> Frontier:
     """Re-expanding advance: the fallback for callers WITHOUT a child-state
     cache from :func:`expand_share_bits` (the crawl paths all have one and
@@ -454,9 +462,11 @@ def advance(
 
     Layout note: the eval recurrence wants interleaved seeds; under the
     planar engine this rare path converts at the edges (tiny next to the
-    PRG work it is about to redo).
+    PRG work it is about to redo).  ``use_pallas`` overrides the process
+    engine (None follows it) for callers that pin a layout — see
+    :func:`expand_share_bits`.
     """
-    planar = _expand_engine()
+    planar = _expand_engine() if use_pallas is None else use_pallas
     if planar:  # plane-major [4,d,2,F,N]/[d,2,F,N] -> interleaved
         frontier = frontier._replace(states=to_interleaved(frontier.states))
     out = _advance_jit(

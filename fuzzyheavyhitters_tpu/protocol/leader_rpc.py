@@ -48,6 +48,7 @@ class RpcLeader:
         self.has_sketch = False
         self._f_bucket = min_bucket  # current frontier bucket (shard plan)
         self._boot_ids: dict = {}  # last known server boot ids
+        self._mesh_faults: dict = {}  # last seen mesh.faults counts
         # leader-side telemetry: level spans (the heartbeat names the
         # level a wedged crawl died in) + survivor gauges
         self.obs = obsmetrics.Registry("leader")
@@ -175,6 +176,12 @@ class RpcLeader:
                         and not self.cfg.secure_whole_level
                         and self.cfg.crawl_shard_nodes
                     ),
+                    # the shard layout this leader BELIEVES the servers
+                    # run (multi-chip client sharding): the servers warm
+                    # their own live layout regardless, but a skew is
+                    # warned about at warmup time instead of surfacing
+                    # as fresh compiles on the measured clock
+                    "data_shards": int(self.cfg.server_data_devices),
                 },
             )
         return {"f_buckets": list(f_buckets), "s0": r0, "s1": r1}
@@ -555,6 +562,30 @@ class RpcLeader:
             if st["boot_id"] != self._boot_ids.get(i):
                 restarted.append(i)
             self._boot_ids[i] = st["boot_id"]
+        # device-loss vs server-loss: a server with an INTACT boot id
+        # whose mesh FAULT counter advanced since the leader last looked
+        # lost a device, not itself — either it already re-sharded in
+        # place (rpc._mesh_recover counts reshards too) or it escalated
+        # for want of a usable checkpoint; the faults counter covers
+        # both, where reshards alone would miss the escalation.  The
+        # delta matters: the counters are cumulative per boot, so an old
+        # fault must not re-attribute a later, unrelated recovery wave
+        # (attribution is "since the last probe" — a silently recovered
+        # reshard between waves lands on the next one).  Name both so
+        # the postmortem (and the recovery tests) can tell the cases
+        # apart without scraping server logs.
+        device_loss = []
+        for i, st in enumerate((st0, st1)):
+            f = int((st.get("mesh") or {}).get("faults") or 0)
+            if i not in restarted and f > self._mesh_faults.get(i, 0):
+                device_loss.append(i)
+            self._mesh_faults[i] = f
+        if restarted or device_loss:
+            obsmod.emit(
+                "resilience.loss_classified",
+                server_loss=restarted,
+                device_loss=device_loss,
+            )
         if stash is None:
             # no checkpoint to stand on: restart the crawl from scratch
             # (sketch mode was refused above — it can never restart)

@@ -82,7 +82,9 @@ from ..obs import metrics as obsmetrics
 from ..ops import baseot, dpf, gc, ibdcf, otext, prg
 from ..ops.fields import F255, FE62
 from ..ops.ibdcf import EvalState, IbDcfKeyBatch
+from ..parallel import server_mesh as smesh
 from ..resilience import admission as resadmission
+from ..resilience import chaos as reschaos
 from ..resilience import policy as respolicy
 from ..utils.config import Config
 from . import collect, mpc, secure, sketch as sketchmod
@@ -476,10 +478,20 @@ class CollectorServer:
     # may swap _admission for one with a manual clock
     _ingest_pools: dict = field(default_factory=dict)
     _admission: object | None = None
+    # multi-chip client sharding (parallel/server_mesh.py): the local
+    # pjit mesh the client axis shards over, and an optional injected
+    # device-loss schedule (resilience.chaos.MeshChaos — reused from the
+    # 2-D mesh path; tests and bin/server wire FHH_MESH_FAULTS here)
+    _mesh: object | None = None
+    _mesh_chaos: object | None = None
 
     def __post_init__(self):
         if self.obs is None:
             self.obs = obsmetrics.Registry(f"server{self.server_id}")
+        if self._mesh is None:
+            k = smesh.resolve_data_devices(self.cfg.server_data_devices)
+            if k > 1:
+                self._mesh = smesh.ServerMesh(k)
         if self._admission is None:
             self._admission = resadmission.AdmissionController(
                 max_window_keys=self.cfg.ingest_window_keys,
@@ -535,16 +547,29 @@ class CollectorServer:
             )
         return True
 
+    def _planar(self) -> bool:
+        """This server's frontier LAYOUT: the process expand engine,
+        except under the multi-chip mesh, which pins interleaved/XLA
+        (the client axis must be a plain named axis — pallas_call takes
+        no sharded operands; same pin as the 2-D mesh bodies)."""
+        return collect._expand_engine() and self._mesh is None
+
     def _concat_keys(self) -> None:
         """Materialize ``self.keys`` from the uploaded chunks (shared by
         ``tree_init`` and ``tree_restore`` — a restored server re-receives
-        its key chunks but must NOT re-root its frontier)."""
+        its key chunks but must NOT re-root its frontier).  Under the
+        multi-chip mesh the batch binds the active shard count and the
+        key planes land client-axis-sharded across the local devices."""
         self.keys = IbDcfKeyBatch(
             *[
+                # fhh-lint: disable=chunked-device-readback,host-sync-in-hot-loop (wire input: the uploaded chunks are host numpy already — np.asarray is a no-copy view; runs once per collection/restore, never per level)
                 np.concatenate([np.asarray(p[i]) for p in self.keys_parts])
                 for i in range(len(self.keys_parts[0]))
             ]
         )
+        if self._mesh is not None:
+            self._mesh.bind(self.keys.cw_seed.shape[0])
+            self.keys = self._mesh.shard_keys(self.keys)
 
     async def tree_init(self, req) -> bool:
         if not self.keys_parts:
@@ -553,7 +578,12 @@ class CollectorServer:
         self._concat_keys()
         n = self.keys.cw_seed.shape[0]
         self.alive_keys = np.ones(n, bool)
-        self.frontier = collect.tree_init(self.keys, root_bucket)
+        if self._mesh is not None:
+            self.frontier = self._mesh.shard_frontier(
+                collect.tree_init(self.keys, root_bucket, planar=False)
+            )
+        else:
+            self.frontier = collect.tree_init(self.keys, root_bucket)
         self._children = None
         self._shard_children.clear()
         self._shard_last.clear()
@@ -585,6 +615,7 @@ class CollectorServer:
         server re-receives its sketch chunks but must NOT re-root its
         frontier-following states)."""
         leaves = [jax.tree.leaves(p) for p in self._sketch_parts]
+        # fhh-lint: disable=chunked-device-readback,host-sync-in-hot-loop (wire input: uploaded sketch chunks are host numpy; once per collection/restore)
         cat = [np.concatenate([np.asarray(p[i]) for p in leaves])
                for i in range(len(leaves[0]))]
         self._sketch = jax.tree.unflatten(
@@ -705,14 +736,14 @@ class CollectorServer:
             mk2 = jnp.expand_dims(jnp.asarray(mk2), 1)
             state = sketchmod.mul_state(fld, out, mk, mk2, trip)
             # one stacked array = one device fetch + one wire message
-            # fhh-lint: disable=host-sync-in-hot-loop (wire fetch: the
+            # fhh-lint: disable=host-sync-in-hot-loop,chunked-device-readback (wire fetch: the
             # exchange below needs host bytes; one fetch per round trip)
             cs = np.asarray(jnp.stack(mpc.cor_share(fld, state)))
             peer_cs = await self._swap(cs)
             pair_cs = (cs, peer_cs) if self.server_id == 0 else (peer_cs, cs)
             opened = mpc.cor(fld, (pair_cs[0][0], pair_cs[0][1]),
                              (pair_cs[1][0], pair_cs[1][1]))
-            # fhh-lint: disable=host-sync-in-hot-loop (wire fetch, as above)
+            # fhh-lint: disable=host-sync-in-hot-loop,chunked-device-readback (wire fetch, as above)
             o = np.asarray(
                 mpc.out_share(fld, bool(self.server_id), state, opened)
             )
@@ -834,7 +865,9 @@ class CollectorServer:
         data-plane exchanges stay positionally matched."""
         if shard is None:
             return self.frontier
-        return collect.frontier_slice(self.frontier, shard[0], shard[1])
+        return collect.frontier_slice(
+            self.frontier, shard[0], shard[1], planar=self._planar()
+        )
 
     def _stash_children(self, level, shard, children) -> None:
         """Bank one crawl's child-state cache for the coming prune: whole
@@ -870,11 +903,20 @@ class CollectorServer:
         bit-identically."""
         frontier = self._shard_frontier(shard)
         packed, children = collect.expand_share_bits(
-            self.keys, frontier, level, want_children=not last
+            self.keys, frontier, level, want_children=not last,
+            use_pallas=False if self._mesh is not None else None,
         )
         out = {"packed": packed, "children": children, "frontier": frontier}
         if self.cfg.secure_exchange:
             d = self.keys.cw_seed.shape[1]
+            if self._mesh is not None:
+                # the 2PC kernel stage runs single-device by design:
+                # gather the packed share bits over ICI before string
+                # extraction — on accelerator hosts the planar Pallas
+                # engines take no sharded operands (CPU tier-1 cannot
+                # catch that: the XLA twins tolerate sharded inputs).
+                # Sharding the kernel stage itself is ROADMAP phase 2.
+                packed = self._mesh.gather(packed)
             strs = secure.child_strings(packed, d)  # [F, C, N, S]
             F_, C, N, S = strs.shape
             out["flat"] = strs.reshape(F_ * C * N, S)
@@ -940,13 +982,31 @@ class CollectorServer:
             peer = await self._swap(packed_np)
         with self.obs.span("field", level=level) as sp_field:
             masks = collect.pattern_masks(self.keys.cw_seed.shape[1])
-            counts = collect.counts_by_pattern(
-                packed, peer, masks, self.alive_keys, frontier.alive
+            counts = await self._reduced_fetch(
+                level, collect.counts_by_pattern,
+                packed, peer, masks, self.alive_keys, frontier.alive,
             )
-            counts = await _fetch(counts, self.obs)
         self._emit_level_phases(level, sp_fss, sp_gc, sp_field)
         self._stash_children(level, shard, children)
         return counts
+
+    async def _reduced_fetch(self, level: int, single_fn, *args):
+        """The per-level reduction + host fetch shared by the trusted
+        (``collect.counts_by_pattern``) and secure
+        (``secure.node_share_sums``) crawl paths.  Under the multi-chip
+        mesh the per-shard client-axis partials fold over ICI (psum)
+        BEFORE the fetch — :class:`~..parallel.server_mesh.ServerMesh`
+        mirrors the single-device reduction API by name, so the mesh
+        form is found via ``single_fn.__name__`` — and the fetch-synced
+        ``ici_reduce`` span is the reduction's cost instrument.  Either
+        way the caller (and with it the wire) gets host values in the
+        single-device layout."""
+        if self._mesh is not None:
+            self.obs.gauge("data_shards", self._mesh.shards, level=level)
+            with self.obs.span("ici_reduce", level=level):
+                out = getattr(self._mesh, single_fn.__name__)(*args)
+                return await _fetch(out, self.obs)
+        return await _fetch(single_fn(*args), self.obs)
 
     async def _phase_sync(self, x) -> None:
         """Device sync at a secure-kernel phase boundary (OFF the event
@@ -1080,8 +1140,10 @@ class CollectorServer:
                     self._zero_phases(level, "garble")
         with self.obs.span("field", level=level) as sp_field:
             vals = vals.reshape((F_, C, N) + count_field.limb_shape)
-            shares = secure.node_share_sums(count_field, vals, jnp.asarray(w))
-            shares = await _fetch(shares, self.obs)
+            shares = await self._reduced_fetch(
+                level, secure.node_share_sums,
+                count_field, vals, jnp.asarray(w),
+            )
         self._emit_level_phases(level, sp_fss, sp_gc, sp_field)
         self._stash_children(level, shard, children)
         return shares
@@ -1111,6 +1173,70 @@ class CollectorServer:
         full = self._mask_cache[1]
         return full if shard is None else full[shard[0] : shard[1]]
 
+    async def _mesh_guard(self, level, thunk):
+        """Device-loss containment for the multi-chip server: fire any
+        scheduled mesh chaos at the crawl boundary (the same consumed-
+        once :class:`resilience.chaos.MeshChaos` schedule the 2-D mesh
+        path uses), and on a mesh fault recover IN PLACE — a lost device
+        is NOT a lost server.  ``state_lost`` (kill) re-shards the
+        frontier from the newest on-disk checkpoint and rebuilds the
+        keys from the host-side upload chunks; a suspect collective
+        (drop) just re-runs.  Either way the crawl re-runs ONCE inside
+        the same verb, so the leader sees a slow span, never a fault —
+        ``shards_rerun`` counts the cost, ``levels_rerun`` stays zero.
+
+        The chaos hook fires BEFORE any data-plane I/O of the level, so
+        the re-run exchanges with the peer exactly once; a real device
+        loss mid-exchange desynchronizes the plane and correctly
+        escalates through the verb error to the leader's plane_reset +
+        retry machinery instead."""
+        try:
+            if self._mesh_chaos is not None:
+                self._mesh_chaos.before_level(self, int(level))
+            return await thunk()
+        except reschaos.MeshFaultError as err:
+            if self._mesh is None:
+                raise
+            await self._mesh_recover(int(level), err)
+            return await thunk()
+
+    async def _mesh_recover(self, level: int, err) -> None:
+        """Re-shard after a device loss (see :meth:`_mesh_guard`)."""
+        self.obs.count("mesh_faults", level=level)
+        self._expand_ready.clear()  # pre-expanded dispatches are suspect
+        state_lost = bool(getattr(err, "state_lost", False))
+        if state_lost or self.frontier is None:
+            prev = level - 1
+            if self.ckpt_dir is None or prev not in self._ckpt_levels():
+                # nothing to re-shard from: surface the original fault —
+                # the supervising leader owns recovery at that point
+                raise RuntimeError(
+                    f"mesh device lost at level {level} with no level-"
+                    f"{prev} checkpoint to re-shard from"
+                ) from err
+            self.keys = None  # device-resident: lost with the shard
+            await self.tree_restore({"level": prev})
+            if self.frontier is None or self.keys is None:
+                # the level stamp existed but the blob was ingest-only
+                # (windowed front door between windows): pools came
+                # back, crawl state did not — escalate exactly like the
+                # no-checkpoint case instead of re-running on None
+                raise RuntimeError(
+                    f"mesh device lost at level {level}: the level-"
+                    f"{prev} checkpoint is ingest-only — no crawl state "
+                    "to re-shard from"
+                ) from err
+            self.obs.count("mesh_reshards", level=level)
+        self.obs.count("shards_rerun", level=level)
+        obs.emit(
+            "resilience.mesh_reshard",
+            severity="warn",
+            server=self.server_id,
+            level=level,
+            state_lost=state_lost,
+            error=str(err),
+        )
+
     async def tree_crawl(self, req) -> np.ndarray:
         """-> FE62 shares of per-child counts [F, 2^d] (ref: rpc.rs:60).
         An optional ``shard: (lo, hi)`` restricts the crawl to that node
@@ -1118,11 +1244,16 @@ class CollectorServer:
         level = req["level"]
         shard = self._parse_shard(req)
         if self.cfg.secure_exchange:
-            return await self._crawl_counts_secure(
-                level, FE62, garbler=int(req.get("garbler", 0)), shard=shard,
-                ot_path=req.get("ot_path"),
+            return await self._mesh_guard(
+                level,
+                lambda: self._crawl_counts_secure(
+                    level, FE62, garbler=int(req.get("garbler", 0)),
+                    shard=shard, ot_path=req.get("ot_path"),
+                ),
             )
-        counts = await self._crawl_counts(level, shard=shard)
+        counts = await self._mesh_guard(
+            level, lambda: self._crawl_counts(level, shard=shard)
+        )
         # NB: trusted mode — both servers hold these plaintext counts; the
         # shared-seed mask below is a WIRE-FORMAT shim so the leader's
         # uniform v0 - v1 reconstruction works, not a secrecy mechanism
@@ -1144,12 +1275,19 @@ class CollectorServer:
         level = req["level"]
         shard = self._parse_shard(req)
         if self.cfg.secure_exchange:
-            shares = await self._crawl_counts_secure(
-                level, F255, last=True, garbler=int(req.get("garbler", 0)),
-                shard=shard, ot_path=req.get("ot_path"),
+            shares = await self._mesh_guard(
+                level,
+                lambda: self._crawl_counts_secure(
+                    level, F255, last=True,
+                    garbler=int(req.get("garbler", 0)), shard=shard,
+                    ot_path=req.get("ot_path"),
+                ),
             )
         else:
-            counts = await self._crawl_counts(level, last=True, shard=shard)
+            counts = await self._mesh_guard(
+                level,
+                lambda: self._crawl_counts(level, last=True, shard=shard),
+            )
             r = self._mask_rows(level, shard, counts.shape[-1], f255=True)
             if self.server_id == 0:
                 c = np.zeros(counts.shape + (8,), np.uint32)
@@ -1185,7 +1323,8 @@ class CollectorServer:
             self._children = None
         else:  # prune without a preceding crawl: re-expand
             self.frontier = collect.advance(
-                self.keys, self.frontier, level, parent, pat_bits, n_alive
+                self.keys, self.frontier, level, parent, pat_bits, n_alive,
+                use_pallas=False if self._mesh is not None else None,
             )
         if self._sketch is not None:
             self._advance_sketch(int(level), parent, pat_bits, n_alive)
@@ -1323,6 +1462,7 @@ class CollectorServer:
             )
         window = int(req["window"])
         sub_id = str(req["sub_id"])
+        # fhh-lint: disable=chunked-device-readback (wire input: pickled host numpy, no device involved)
         chunk = tuple(np.asarray(a) for a in req["keys"])
         n_keys = int(chunk[0].shape[0])
         pool = self._ingest_pool(window)
@@ -1463,6 +1603,24 @@ class CollectorServer:
             # streaming front-door health (pool occupancy per window,
             # unsealed queue depth, admit/shed/reject counters)
             "ingest": self._ingest_status(),
+            # multi-chip mesh health (None on a single-device server):
+            # device/shard counts, per-shard client occupancy, and the
+            # reduction/recovery instruments the run report rolls up
+            "mesh": self._mesh_status(),
+        }
+
+    def _mesh_status(self) -> dict | None:
+        if self._mesh is None:
+            return None
+        return {
+            "data_devices": self._mesh.n_devices,
+            "data_shards": self._mesh.shards,
+            "shard_clients": self._mesh.occupancy(),
+            "ici_reduce_seconds": round(
+                self.obs.timer_seconds("ici_reduce"), 6
+            ),
+            "reshards": int(self.obs.counter_value("mesh_reshards")),
+            "faults": int(self.obs.counter_value("mesh_faults")),
         }
 
     def _ckpt_levels(self) -> list:
@@ -1518,7 +1676,9 @@ class CollectorServer:
         crawled with), not a cryptographic one — the leader is trusted
         with key halves by definition."""
         h = hashlib.sha256()
+        # fhh-lint: disable=host-sync-in-hot-loop (checkpoint/restore identity check: once per checkpoint, not per level)
         h.update(np.ascontiguousarray(np.asarray(self.keys.key_idx)))
+        # fhh-lint: disable=host-sync-in-hot-loop (as above)
         h.update(np.ascontiguousarray(np.asarray(self.keys.root_seed)))
         return np.frombuffer(h.digest(), np.uint8)
 
@@ -1573,7 +1733,7 @@ class CollectorServer:
                     fetch["sk_pairs"] = self._sketch_pairs[0]
             blob = jax.device_get(fetch)
             blob["alive_keys"] = np.asarray(self.alive_keys)
-            blob["planar"] = np.bool_(collect._expand_engine())
+            blob["planar"] = np.bool_(self._planar())
             blob["keys_fp"] = self._keys_fp()
         else:
             blob = {"ing_only": np.bool_(True)}
@@ -1668,6 +1828,7 @@ class CollectorServer:
         if "ing_windows" not in z:
             return None
         parsed = []
+        # fhh-lint: disable=host-sync-in-hot-loop (checkpoint blob: host npz entries)
         ws = np.asarray(z["ing_windows"], np.int64)  # checkpoint blob: host
         for i, w in enumerate(ws):
             req_keys = {f"ing{i}_meta", f"ing{i}_sub_ids", f"ing{i}_sub_codes",
@@ -1865,6 +2026,7 @@ class CollectorServer:
                 f"this key batch's tree (data_len={L}) — wrong collection"
             )
         n = self.keys.cw_seed.shape[0]
+        # fhh-lint: disable=host-sync-in-hot-loop (restore path: host npz entry, once per recovery)
         alive_keys = np.asarray(z["alive_keys"])
         if alive_keys.shape[0] != n:
             raise RuntimeError(
@@ -1900,7 +2062,7 @@ class CollectorServer:
             bit=jax.device_put(z["bit"]),
             y_bit=jax.device_put(z["y_bit"]),
         )
-        saved_planar, planar = bool(z["planar"]), collect._expand_engine()
+        saved_planar, planar = bool(z["planar"]), self._planar()
         if saved_planar != planar:
             states = (
                 collect.to_interleaved(states)
@@ -1911,6 +2073,12 @@ class CollectorServer:
         self.frontier = collect.Frontier(
             states=states, alive=jax.device_put(z["alive"])
         )
+        if self._mesh is not None:
+            # re-shard from the host-side blob: the frontier lands
+            # client-axis-sharded across whatever local devices are
+            # live — this is the device-loss recovery primitive (a lost
+            # device is re-covered by re-placement, not a server restart)
+            self.frontier = self._mesh.shard_frontier(self.frontier)
         self._children = None
         self._last_shares = None
         self._shard_children.clear()
@@ -1924,9 +2092,12 @@ class CollectorServer:
                 seed=jax.device_put(z["sk_state_seed"]),
                 t=jax.device_put(z["sk_state_t"]),
             )
+            # fhh-lint: disable=host-sync-in-hot-loop (restore path: host npz entries, once per recovery)
             self._sketch_pids = np.asarray(z["sk_pids"])
             self._sketch_depth = int(z["sk_depth"])
+            # fhh-lint: disable=host-sync-in-hot-loop (as above)
             self._sketch_root = np.asarray(z["sk_root"], np.uint32).copy()
+            # fhh-lint: disable=host-sync-in-hot-loop (as above)
             self._ratchet_digest = np.asarray(
                 z["sk_digest"], np.uint8
             ).tobytes()
@@ -2008,6 +2179,32 @@ class CollectorServer:
         # (a leader that will crawl with secure_whole_level=False)
         ot_path = (req or {}).get("ot_path") or self.cfg.ot_path
         want_spans = bool((req or {}).get("secure_spans"))
+        # the leader names its shard layout so a config skew (a leader
+        # that believes this server runs k-way sharded when it does not,
+        # or vice versa) surfaces at warmup time instead of as mystery
+        # recompiles on the measured clock; the server's own mesh is
+        # authoritative — warmup always compiles the programs the LIVE
+        # crawl will dispatch
+        want_devices = (req or {}).get("data_shards")
+        have_shards = 1 if self._mesh is None else self._mesh.shards
+        if want_devices is not None and int(want_devices) > 0:
+            # the leader names a DEVICE budget; resolve it exactly like
+            # this server resolved its own (visible-device cap, then
+            # the largest divisor of the bound client batch) so
+            # identically-configured pairs never warn — only real
+            # config skew does
+            want_shards = smesh._largest_divisor_leq(
+                self.keys.cw_seed.shape[0],
+                smesh.resolve_data_devices(int(want_devices)),
+            )
+            if want_shards != have_shards:
+                obs.emit(
+                    "warmup.shard_mismatch",
+                    severity="warn",
+                    server=self.server_id,
+                    leader_data_shards=want_shards,
+                    server_data_shards=have_shards,
+                )
         L = self.keys.cw_seed.shape[-2]
         shapes = 0
         with self.obs.span("warmup"):
@@ -2043,30 +2240,59 @@ class CollectorServer:
         a crawl at frontier bucket ``fb`` will hit: expand with and
         without children, the trusted count reduction, and in secure
         mode the OT-extension + equality + b2a + share-sum chain for both
-        FE62 (inner levels) and F255 (the leaf level)."""
-        fr = collect.tree_init(self.keys, fb)
+        FE62 (inner levels) and F255 (the leaf level).  Under the
+        multi-chip mesh every stage warms with the SHARDED layout the
+        live crawl dispatches (keys are already client-axis-sharded, the
+        frontier pins interleaved, reductions go through the shard_map
+        psum kernels) — jit executables key on input shardings, so
+        warming unsharded twins would leave every live program cold."""
+        mesh = self._mesh
+        if mesh is not None:
+            fr = mesh.shard_frontier(
+                collect.tree_init(self.keys, fb, planar=False)
+            )
+        else:
+            fr = collect.tree_init(self.keys, fb)
         d = self.keys.cw_seed.shape[1]
         lasts = (False, True) if L > 1 else (True,)
         for last in lasts:
             level = L - 1 if last else 0
             packed, _ = collect.expand_share_bits(
-                self.keys, fr, level, want_children=not last
+                self.keys, fr, level, want_children=not last,
+                use_pallas=False if mesh is not None else None,
             )
             if self.cfg.secure_exchange:
                 secure.warm_level_kernels(
-                    packed, d, F255 if last else FE62,
+                    # same pre-kernel gather as the live expand stage
+                    # (_do_expand) — warm and live must dispatch the
+                    # same single-device 2PC programs
+                    packed if mesh is None else mesh.gather(packed),
+                    d, F255 if last else FE62,
                     path=ot_path or self.cfg.ot_path,
+                    share_sums=mesh.node_share_sums if mesh is not None
+                    else None,
                 )
             else:
                 masks = collect.pattern_masks(d)
-                jax.block_until_ready(
-                    collect.counts_by_pattern(
-                        packed, packed, masks, self.alive_keys
-                        if self.alive_keys is not None
-                        else np.ones(self.keys.cw_seed.shape[0], bool),
-                        fr.alive,
-                    )
+                alive = (
+                    self.alive_keys
+                    if self.alive_keys is not None
+                    else np.ones(self.keys.cw_seed.shape[0], bool)
                 )
+                if mesh is not None:
+                    # peer rows arrive as host numpy on the live path
+                    peer = np.asarray(packed)  # fhh-lint: disable=chunked-device-readback,host-sync-in-hot-loop (warmup only: deliberately mirrors the live wire round trip, off the measured clock)
+                    jax.block_until_ready(
+                        mesh.counts_by_pattern(
+                            packed, peer, masks, alive, fr.alive
+                        )
+                    )
+                else:
+                    jax.block_until_ready(
+                        collect.counts_by_pattern(
+                            packed, packed, masks, alive, fr.alive
+                        )
+                    )
 
     # -- wiring ----------------------------------------------------------
 

@@ -704,7 +704,8 @@ def _warm_pair():
     return _warm_sessions
 
 
-def warm_level_kernels(packed, d: int, field, path: str = "auto") -> None:
+def warm_level_kernels(packed, d: int, field, path: str = "auto",
+                       share_sums=None) -> None:
     """Run the WHOLE per-level 2PC kernel chain — string extraction,
     Δ-OT extension, the b2a share pair (both garbling signs), the
     whole-level equality message (1-of-2^S table or packed garbled
@@ -715,7 +716,21 @@ def warm_level_kernels(packed, d: int, field, path: str = "auto") -> None:
     dispatch is compiled (and lands in the persistent compile cache,
     utils/compile_cache) before measured crawl time starts.  The live OT
     sessions and the data plane are never touched; the outputs are
-    discarded."""
+    discarded.
+
+    The wire arrays (ev u-matrix, the sender's planar message) ROUND
+    TRIP through host numpy exactly like the live socket path: jit
+    executables key on input shardings/placements, so feeding the
+    consumer the producer's still-on-device output would warm programs a
+    live crawl — whose wire inputs arrive as host pickles — never
+    dispatches.  Single-device runs happened to tolerate the mismatch;
+    a multi-chip server (parallel/server_mesh.py) does not, because its
+    device-side outputs carry mesh shardings the live host inputs lack.
+
+    ``share_sums`` overrides the share-sum reduction (the multi-chip
+    server passes its ICI-psum form, ``ServerMesh.node_share_sums``, so
+    the sharded reduction program is warmed too); None = the
+    single-device :func:`node_share_sums`."""
     strs = child_strings(packed, d)
     F_, C, N, S = strs.shape
     B = F_ * C * N
@@ -724,15 +739,16 @@ def warm_level_kernels(packed, d: int, field, path: str = "auto") -> None:
     zero = np.zeros(4, np.uint32)
     gseed, bseed = derive_seed(zero, 1, 0), derive_seed(zero, 2, 0)
     u, t_rows, idx0 = ev_step1_fused(rcv, flat)
+    u = np.asarray(u)  # wire round trip (see docstring)
     # the real crawl alternates the garbler per level, so each server
     # runs BOTH payload-pair signs (r0 + 1 and r0 - 1) at this shape
     for g in (0, 1):
         b2a_payload_pair(field, bseed, B, g)
     msg, _ = gb_step_level(snd, u, flat, gseed, bseed, field, 0, path=path)
+    msg = np.asarray(msg)  # wire round trip (see docstring)
     vals = ev_open_level(t_rows, flat, msg, B, S, field, idx0, path=path)
     w = jnp.ones((F_, C, N), bool)
+    reduce_fn = node_share_sums if share_sums is None else share_sums
     jax.block_until_ready(
-        node_share_sums(
-            field, vals.reshape((F_, C, N) + field.limb_shape), w
-        )
+        reduce_fn(field, vals.reshape((F_, C, N) + field.limb_shape), w)
     )

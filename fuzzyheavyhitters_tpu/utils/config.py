@@ -86,6 +86,18 @@ class Config:
     # span granularity, span pipelining) at the cost of fragmenting the
     # equality batch into host-sized chunks.
     secure_whole_level: bool = True
+    # multi-chip collector servers (parallel/server_mesh.py): how many
+    # LOCAL devices each CollectorServer shards the client axis over.
+    # 0 = auto: every visible local device on an accelerator host, ONE on
+    # a CPU host (the virtual host-platform devices exist for tests —
+    # production CPU servers gain nothing from sharding a host backend;
+    # tests/bench pass explicit counts under
+    # XLA_FLAGS=--xla_force_host_platform_device_count=8).  1 pins the
+    # single-device path; N > 1 requests exactly N (capped at the visible
+    # device count, then at the largest divisor of the client batch).
+    # Results are bit-identical at every setting: sharding is a physical
+    # layout, the 2PC transcript never changes (asserted in tier-1).
+    server_data_devices: int = 0
     # per-level secure-kernel phase split (phase_otext/garble/eval/b2a
     # spans in the run report): True syncs the device at each phase
     # boundary so the spans carry real device time — the acceptance

@@ -4,9 +4,11 @@
 # Usage: scripts/bench_smoke.sh [budget_seconds]
 #   - runs `python bench.py` in SMOKE mode (FHH_BENCH_SMOKE=1: tiny
 #     CPU-safe shapes — np-engine keygen + a small pipelined secure
-#     crawl with its sequential bit-identity assertion; the heavyweight
-#     chip sections report {"skipped": "smoke"}) under a wall-clock
-#     budget (FHH_BENCH_BUDGET, default 480 s)
+#     crawl with its sequential bit-identity assertion, the streaming
+#     ingest pair, and the multichip sharded legs on the 8-device
+#     virtual mesh; the heavyweight chip sections report
+#     {"skipped": "smoke"}) under a wall-clock budget
+#     (FHH_BENCH_BUDGET, default 600 s)
 #   - FAILS unless the bench exits rc=0 AND its last stdout line is
 #     parseable JSON carrying the headline metric — exactly what the
 #     harness needs (BENCH_r04 printed an oversized line that parsed as
@@ -16,8 +18,15 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-budget="${1:-480}"
+budget="${1:-600}"
 out="$(mktemp)"
+
+# 8 virtual host devices so the multichip section's 2- and 4-shard legs
+# run on a CPU host (same mesh the tier-1 suite exercises);
+# optimization_level=1 sidesteps XLA:CPU's pathological ChaCha-scan pass
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+    export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8 --xla_backend_optimization_level=1"
+fi
 
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" FHH_BENCH_SMOKE=1 \
     FHH_BENCH_BUDGET="$budget" \
@@ -58,6 +67,15 @@ assert "ingest_keys_per_sec" in ing and ing.get("bit_identical_vs_batch"), (
     "ingest section (streaming front door: keys/sec + batch bit-identity) "
     "missing from the compact line: " + last[:300]
 )
+mc = doc.get("extra", {}).get("multichip", {})
+assert mc.get("bit_identical") and mc.get("data_shards", 0) >= 2, (
+    "multichip section (client-axis sharding: bit-identity at "
+    ">= 2 data shards) missing from the compact line: " + last[:300]
+)
+assert "ici_reduce_seconds" in mc and "secure_clients_per_sec" in mc, (
+    "multichip section missing ici_reduce_seconds / per-shard rates: "
+    + last[:300]
+)
 print(
     "bench_smoke OK: "
     f"{doc['metric']}={doc['value']}, "
@@ -65,6 +83,8 @@ print(
     f"ot_path={sk['ot_path']}, "
     f"pipeline_speedup={sc.get('pipeline_speedup')}, "
     f"ingest_keys_per_sec={ing['ingest_keys_per_sec']}, "
+    f"multichip_shards={mc['data_shards']} "
+    f"(rates={mc['secure_clients_per_sec']}), "
     f"line={len(last)}B, elapsed={doc.get('budget', {}).get('elapsed_s')}s"
 )
 EOF

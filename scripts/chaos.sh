@@ -6,8 +6,10 @@
 #     the socket-path suite (tests/test_resilience.py — control/data
 #     plane chaos, sketch recovery via the challenge ratchet, sharded
 #     mid-level retry), the mesh/ICI suite (tests/test_mesh_chaos.py),
-#     AND the streaming-ingest suite (tests/test_ingest.py — admission
-#     control, flood/slowclient chaos, kill-mid-window recovery),
+#     the streaming-ingest suite (tests/test_ingest.py — admission
+#     control, flood/slowclient chaos, kill-mid-window recovery), AND
+#     the multi-chip suite (tests/test_multichip.py — sharded-vs-single
+#     bit-identity, device-loss re-shard recovery),
 #     INCLUDING the slow-marked multi-fault storm tier-1 skips
 #   - writes a JSON artifact ({passed, failed, duration_s, tests}) to $1
 #     (default: chaos_report.json); exits non-zero on any failure
@@ -25,6 +27,7 @@ report="$(mktemp)"
 
 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_resilience.py tests/test_mesh_chaos.py tests/test_ingest.py \
+    tests/test_multichip.py \
     -m "" -q \
     -p no:cacheprovider --junitxml="$report"
 rc=$?

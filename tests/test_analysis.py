@@ -702,7 +702,8 @@ def test_pyproject_and_dataclass_defaults_do_not_drift():
     for key in (
         "hot_modules", "hot_roots", "secret_lexicon", "sink_calls",
         "print_scope", "print_allowed", "shared_state_modules",
-        "await_modules", "queue_modules", "default_paths", "baseline",
+        "await_modules", "readback_modules", "queue_modules",
+        "default_paths", "baseline",
     ):
         assert getattr(operative, key) == getattr(defaults, key), key
 
@@ -797,10 +798,22 @@ def test_chunked_readback_scoped_to_readback_modules():
         src, "fuzzyheavyhitters_tpu/ops/fake.py",
         rule="chunked-device-readback",
     )
-    # rpc.py is deliberately OUT of scope: its per-batch wire fetches
-    # (sketch_verify) carry host-sync suppressions with justifications
+    # rpc.py and parallel/ joined the scope with the multi-chip refactor
+    # (the crawl verbs' expand/open stages and the sharded mesh paths
+    # must never regrow per-chunk fetch loops); the sanctioned wire
+    # fetches there carry inline suppressions with justifications
     assert _lint(
         src, "fuzzyheavyhitters_tpu/protocol/rpc.py",
+        rule="chunked-device-readback",
+    )
+    assert _lint(
+        src, "fuzzyheavyhitters_tpu/parallel/server_mesh.py",
+        rule="chunked-device-readback",
+    )
+    # the control/driver layers stay out: their wire-input conversions
+    # are host numpy by construction
+    assert _lint(
+        src, "fuzzyheavyhitters_tpu/protocol/leader_rpc.py",
         rule="chunked-device-readback",
     ) == []
     assert _lint(src, "tests/test_x.py", rule="chunked-device-readback") == []

@@ -1,0 +1,252 @@
+"""Multi-chip collector servers: client-axis sharding over each server's
+local device mesh (parallel/server_mesh.py + protocol/rpc.py).
+
+Exercised on the 8-device virtual CPU mesh (conftest forces
+``--xla_force_host_platform_device_count=8``).  The contract under test:
+sharding is a PHYSICAL layout — a sharded server is bit-identical to a
+single-device one in every mode (trusted, secure on both equality-test
+paths, malicious/sketch), the wire and the leader cannot tell them
+apart, and a lost data device is recovered by re-sharding from the
+host-side checkpoint (``shards_rerun``), never by a server-loss
+recovery (``levels_rerun`` stays zero).
+"""
+
+import asyncio
+import tempfile
+
+import numpy as np
+import pytest
+
+from fuzzyheavyhitters_tpu.obs import report as obsreport
+from fuzzyheavyhitters_tpu.ops import ibdcf
+from fuzzyheavyhitters_tpu.parallel import server_mesh
+from fuzzyheavyhitters_tpu.protocol import rpc, sketch
+from fuzzyheavyhitters_tpu.protocol.leader_rpc import RpcLeader
+from fuzzyheavyhitters_tpu.ops.fields import F255, FE62
+from fuzzyheavyhitters_tpu.resilience.chaos import (
+    MeshChaos,
+    parse_mesh_faults,
+)
+from fuzzyheavyhitters_tpu.utils import bits as bitutils
+from fuzzyheavyhitters_tpu.utils.config import Config
+
+BASE_PORT = 43810
+
+L, N_CLIENTS, D = 5, 12, 1
+
+
+def _cfg(port_base, **kw):
+    # f_max=8 keeps the per-bucket program ladder small on XLA:CPU (the
+    # sharded variants each compile their own SPMD programs)
+    defaults = dict(
+        data_len=L,
+        n_dims=D,
+        ball_size=1,
+        addkey_batch_size=12,
+        num_sites=4,
+        threshold=0.2,
+        zipf_exponent=1.03,
+        server0=f"127.0.0.1:{port_base}",
+        server1=f"127.0.0.1:{port_base + 10}",
+        distribution="zipf",
+        f_max=8,
+    )
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+@pytest.fixture(scope="module")
+def client_keys():
+    rng = np.random.default_rng(77)
+    pts = np.concatenate(
+        [np.full((N_CLIENTS - 4, D), 11),
+         rng.integers(0, 1 << L, size=(4, D))]
+    )
+    pts_bits = np.array(
+        [[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts]
+    )
+    return pts_bits, ibdcf.gen_l_inf_ball(pts_bits, 1, rng, engine="np")
+
+
+@pytest.fixture(scope="module")
+def sketch_keys(client_keys):
+    rng = np.random.default_rng(78)
+    pts_bits, _ = client_keys
+    seeds = rng.integers(
+        0, 2**32, size=(N_CLIENTS, D, 2, 4), dtype=np.uint32
+    )
+    cseed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    return sketch.gen(seeds, pts_bits, FE62, F255, cseed)
+
+
+async def _crawl(cfg, port, k0, k1, sk0=None, sk1=None, *, warmup=False,
+                 chaos=None, ckpt_dir=None, supervised=False):
+    s0 = rpc.CollectorServer(0, cfg, ckpt_dir=ckpt_dir, _mesh_chaos=chaos)
+    s1 = rpc.CollectorServer(1, cfg, ckpt_dir=ckpt_dir)
+    t1 = asyncio.create_task(
+        s1.start("127.0.0.1", port + 10, "127.0.0.1", port + 11)
+    )
+    await asyncio.sleep(0.05)
+    t0 = asyncio.create_task(
+        s0.start("127.0.0.1", port, "127.0.0.1", port + 11)
+    )
+    await asyncio.gather(t0, t1)
+    c0 = await rpc.CollectorClient.connect("127.0.0.1", port)
+    c1 = await rpc.CollectorClient.connect("127.0.0.1", port + 10)
+    lead = RpcLeader(cfg, c0, c1)
+    try:
+        if supervised:
+            res = await lead.run_supervised(
+                N_CLIENTS, k0, k1, sk0, sk1, checkpoint_every=1,
+                warmup=warmup,
+            )
+        else:
+            await lead._both("reset")
+            await lead.upload_keys(k0, k1, sk0, sk1)
+            if warmup:
+                await lead.warmup()
+            res = await lead.run(N_CLIENTS)
+        status0 = await c0.call("status")
+        report = obsreport.run_report([s0.obs, s1.obs, lead.obs])
+    finally:
+        for c in (c0, c1):
+            await c.aclose()
+        for s in (s0, s1):
+            await s.aclose()
+    return res, status0, report
+
+
+def _run(cfg, port, k0, k1, **kw):
+    return asyncio.run(_crawl(cfg, port, k0, k1, **kw))
+
+
+def test_largest_divisor_shard_binding():
+    """Shard counts must tile the client batch: a prime batch degrades
+    to one shard, non-divisible requests fall to the largest divisor."""
+    f = server_mesh._largest_divisor_leq
+    assert f(12, 4) == 4
+    assert f(12, 8) == 6
+    assert f(13, 8) == 1
+    assert f(12, 1) == 1
+    m = server_mesh.ServerMesh(4).bind(6)
+    assert m.shards == 3 and m.occupancy() == [2, 2, 2]
+    m.bind(12)
+    assert m.shards == 4 and m.occupancy() == [3, 3, 3, 3]
+
+
+@pytest.mark.parametrize(
+    "mode",
+    ["trusted", "secure_ot2s", "secure_gc", "sketch"],
+)
+def test_sharded_vs_single_device_bit_identical(mode, client_keys,
+                                                sketch_keys):
+    """THE multichip acceptance: data_shards ∈ {2, 4} crawls are
+    bit-identical to the single-device crawl — trusted, secure on BOTH
+    equality-test paths, and malicious (sketch) mode — and the sharded
+    servers report mesh health through ``status`` and the run report."""
+    _, (k0, k1) = client_keys
+    sk0 = sk1 = None
+    kw = {}
+    if mode == "secure_ot2s":
+        kw = dict(secure_exchange=True, ot_path="ot2s")
+    elif mode == "secure_gc":
+        kw = dict(secure_exchange=True, ot_path="gc")
+    elif mode == "sketch":
+        sk0, sk1 = sketch_keys
+    port = BASE_PORT + 40 * (
+        ["trusted", "secure_ot2s", "secure_gc", "sketch"].index(mode)
+    )
+    base = None
+    for i, shards in enumerate((1, 2, 4)):
+        cfg = _cfg(port + 1200 * i, server_data_devices=shards, **kw)
+        res, status0, report = _run(
+            cfg, port + 1200 * i, k0, k1, sk0=sk0, sk1=sk1
+        )
+        assert res.paths.shape[0] >= 1
+        if shards == 1:
+            base = res
+            assert status0["mesh"] is None
+            assert "mesh" not in report
+            continue
+        # bit-identity: the leader-visible result is byte-for-byte the
+        # single-device one (sharding is a physical layout, the 2PC
+        # transcript and reconstruction never change)
+        np.testing.assert_array_equal(base.paths, res.paths)
+        np.testing.assert_array_equal(base.counts, res.counts)
+        # mesh health: status names devices/shards/occupancy and the
+        # run report rolls the mesh section up
+        m = status0["mesh"]
+        assert m["data_shards"] == shards
+        assert m["shard_clients"] == [N_CLIENTS // shards] * shards
+        assert m["ici_reduce_seconds"] > 0
+        assert report["mesh"]["data_shards"] == shards
+        assert report["mesh"]["ici_reduce_seconds"] > 0
+        assert report["mesh"]["reshards"] == 0
+        levels = report["mesh"]["by_level"]
+        assert set(levels) == {str(lv) for lv in range(L)}
+
+
+def test_device_loss_reshards_not_restarts(client_keys):
+    """Kill one simulated data device mid-level (the 2-D mesh path's
+    ``mesh:kill`` chaos clause reused): the server re-shards its
+    frontier from the host-side checkpoint IN PLACE and re-runs the
+    level's crawl inside the same verb — results bit-identical, the
+    recovery section counts a shard re-run and ZERO level re-runs (a
+    lost device is not a lost server: no restart, no scratch restart,
+    no leader recovery wave)."""
+    _, (k0, k1) = client_keys
+    port = BASE_PORT + 600
+    base, _, _ = _run(
+        _cfg(port, server_data_devices=1, secure_exchange=True), port,
+        k0, k1,
+    )
+    chaos = MeshChaos(parse_mesh_faults("mesh:kill@level=3"))
+    with tempfile.TemporaryDirectory() as td:
+        res, status0, report = _run(
+            _cfg(port + 1200, server_data_devices=2, secure_exchange=True),
+            port + 1200, k0, k1,
+            chaos=chaos, ckpt_dir=td, supervised=True,
+        )
+    assert chaos.fired == [("kill", 3)]
+    np.testing.assert_array_equal(base.paths, res.paths)
+    np.testing.assert_array_equal(base.counts, res.counts)
+    # the recovery happened at DEVICE granularity: one shard re-run, no
+    # completed level re-ran, no supervisor recovery wave fired
+    rec = report["recovery"]
+    assert rec["shards_rerun"] >= 1
+    assert rec["levels_rerun"] == 0
+    assert rec["count"] == 0
+    assert report["mesh"]["reshards"] == 1
+    assert report["mesh"]["faults"] == 1
+    assert status0["mesh"]["reshards"] == 1
+
+
+def test_device_loss_without_checkpoint_escalates(client_keys):
+    """A lost device with no checkpoint to re-shard from must surface
+    loudly to the leader (supervisor-level recovery owns it), never
+    silently crawl on clobbered state."""
+    _, (k0, k1) = client_keys
+    port = BASE_PORT + 3200
+    chaos = MeshChaos(parse_mesh_faults("mesh:kill@level=2"))
+    cfg = _cfg(port, server_data_devices=2)
+    with pytest.raises(RuntimeError, match="no level-1 checkpoint"):
+        _run(cfg, port, k0, k1, chaos=chaos)
+
+
+def test_warmed_multichip_crawl_zero_fresh_compiles(client_keys):
+    """The warmup contract extends to the sharded ladder: after one
+    warmed MULTI-CHIP secure crawl, a second identically-shaped warmed
+    crawl (fresh servers, fresh sessions) triggers ZERO fresh XLA
+    compiles — warmup compiles the sharded expand/reduce/2PC programs
+    the live crawl dispatches, wire arrays round-tripped through host
+    numpy exactly like the socket path."""
+    from fuzzyheavyhitters_tpu.utils import compile_cache
+
+    _, (k0, k1) = client_keys
+    port = BASE_PORT + 4000
+    kw = dict(server_data_devices=2, secure_exchange=True)
+    _run(_cfg(port, **kw), port, k0, k1, warmup=True)
+    before = compile_cache.backend_compiles()
+    _run(_cfg(port + 1200, **kw), port + 1200, k0, k1, warmup=True)
+    fresh = compile_cache.backend_compiles() - before
+    assert fresh == 0, f"{fresh} fresh compiles in a warmed multichip crawl"
