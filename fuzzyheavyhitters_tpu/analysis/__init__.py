@@ -13,7 +13,14 @@ families reviewer vigilance kept missing:
 - **thread safety + failure honesty** — module-level shared state only
   written under its registered lock; no silent catch-alls; no bare
   print telemetry (``unguarded-shared-state``, ``broad-except``,
-  ``bare-print``).
+  ``bare-print``);
+- **asyncio lock discipline** — the interprocedural fhh-race pair
+  (:mod:`.concurrency`): guard-mapped shared attributes accessed only
+  with their owning lock provably held, and no guarded snapshot used
+  across a suspension point the lock did not cover
+  (``guarded-state-unlocked``, ``stale-read-across-await``), validated
+  at runtime by the ``FHH_DEBUG_GUARDS`` sanitizer
+  (:mod:`fuzzyheavyhitters_tpu.utils.guards`).
 
 Usage::
 

@@ -82,6 +82,21 @@ def write_baseline(path: str, findings, keep: dict | None = None) -> dict:
     return doc
 
 
+def removed_rules(counts: dict, known_rules) -> list:
+    """``(rule, files, findings)`` for baseline entries whose rule id no
+    longer names a registered rule.  A rule RENAME used to read as a
+    silent shrink at ``--update-baseline`` time — the old id's entries
+    just vanished from the rewritten file, indistinguishable from a
+    genuine burn-down — so the CLI now names every dropped id loudly and
+    tells the operator to check the successor id carries its own
+    entries."""
+    return [
+        (rule, len(paths), sum(paths.values()))
+        for rule, paths in sorted(counts.items())
+        if rule not in known_rules and paths
+    ]
+
+
 def apply_baseline(findings, counts: dict, scanned=None) -> BaselineResult:
     """Split findings into absorbed-vs-new under the baseline counts.
 

@@ -18,9 +18,15 @@ import json
 import os
 import sys
 
-from .baseline import apply_baseline, load_baseline, write_baseline
+from .baseline import (
+    apply_baseline,
+    load_baseline,
+    removed_rules,
+    write_baseline,
+)
 from .config import find_repo_root, load_config
 from .engine import iter_python_files, lint_paths
+from .rules import RULES_BY_NAME
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -123,6 +129,18 @@ def main(argv=None) -> int:
             old = load_baseline(baseline_path)
         except (ValueError, OSError, json.JSONDecodeError):
             old = {}
+        # a rule RENAME must not read as a silent burn-down: entries
+        # under ids no rule carries anymore are named explicitly (the
+        # operator verifies the successor id has its own entries — or
+        # celebrates an actually-deleted rule)
+        for rule, nfiles, n in removed_rules(old, RULES_BY_NAME):
+            print(
+                f"fhh-lint: dropping baseline entries for UNKNOWN rule "
+                f"id '{rule}' ({n} finding(s) across {nfiles} file(s)) — "
+                "renamed or removed rule; if renamed, confirm the new "
+                "id's entries below",
+                file=sys.stderr,
+            )
         keep = {
             rule: {
                 p: n
@@ -130,6 +148,7 @@ def main(argv=None) -> int:
                 if p not in scanned and os.path.exists(os.path.join(root, p))
             }
             for rule, per_path in old.items()
+            if rule in RULES_BY_NAME
         }
         write_baseline(baseline_path, findings, keep=keep)
         kept = sum(len(v) for v in keep.values() if v)
@@ -159,6 +178,9 @@ def main(argv=None) -> int:
             "root": root,
             "paths": paths,
             "strict": bool(args.strict),
+            # the active rule ids: the artifact proves which passes ran
+            # (CI asserts the fhh-race pair is among them)
+            "rules": sorted(RULES_BY_NAME),
             "findings": [f.to_json() for f in res.new],
             "baselined": res.absorbed,
             "stale_baseline": [

@@ -23,6 +23,38 @@ import os
 import re
 from dataclasses import dataclass, field
 
+# the fhh-race guard map shipped for THIS repo (mirrored by pyproject
+# [tool.fhh-lint.guards] — the drift test keeps the two in sync): each
+# shared attribute of the server verb plane / windowed-ingest driver is
+# bound to the asyncio lock that owns it.  Module-level globals (obs,
+# native, utils/compile_cache) are bound inline via `# fhh-guard:` — a
+# dotless key here would apply to every module in scope.
+_DEFAULT_GUARDS = {
+    # CollectorServer: everything the verb plane mutates serializes on
+    # _verb_lock; the deliberately-unlocked fast paths (add_keys /
+    # submit_keys / the frame-arrival pre-expand) carry VERIFIED
+    # `# fhh-race: atomic` contracts + runtime guards.unguarded()
+    # windows.
+    "CollectorServer.frontier": "_verb_lock",
+    "CollectorServer.keys": "_verb_lock",
+    "CollectorServer.keys_parts": "_verb_lock",
+    "CollectorServer.alive_keys": "_verb_lock",
+    "CollectorServer._expand_ready": "_verb_lock",
+    "CollectorServer._ingest_pools": "_verb_lock",
+    "CollectorServer._admission": "_verb_lock",
+    "CollectorServer._sessions": "_verb_lock",
+    "CollectorServer._sketch_parts": "_verb_lock",
+    "CollectorServer._sketch_root": "_verb_lock",
+    "CollectorServer._ratchet_digest": "_verb_lock",
+    # WindowedIngest: gate-order == mirror-order state serializes on
+    # _submit_lock (recovery additionally takes _recover_lock INSIDE it,
+    # so every journal access holds _submit_lock)
+    "WindowedIngest.window": "_submit_lock",
+    "WindowedIngest._journal": "_submit_lock",
+    "WindowedIngest._journaled": "_submit_lock",
+    "WindowedIngest._sealed": "_submit_lock",
+}
+
 
 @dataclass
 class LintConfig:
@@ -109,12 +141,30 @@ class LintConfig:
         "fuzzyheavyhitters_tpu/protocol",
         "fuzzyheavyhitters_tpu/resilience",
     )
+    # fhh-race rules (analysis/concurrency.py): modules whose asyncio
+    # lock discipline is analyzed interprocedurally — the server verb
+    # plane, the driver/ingest plane, and the threading-locked obs/
+    # native/compile-cache globals the guard annotations live in
+    race_modules: tuple = (
+        "fuzzyheavyhitters_tpu/protocol",
+        "fuzzyheavyhitters_tpu/resilience",
+        "fuzzyheavyhitters_tpu/obs",
+        "fuzzyheavyhitters_tpu/native",
+        "fuzzyheavyhitters_tpu/utils/compile_cache.py",
+    )
+    # fhh-race guard map: "ClassName.attr" -> owning lock attribute.
+    # The operative copy lives in pyproject [tool.fhh-lint.guards]; the
+    # runtime twin maps (rpc._SERVER_GUARDS, leader_rpc._INGEST_GUARDS)
+    # are drift-tested against it in tests/test_concurrency.py.
+    guards: dict = field(
+        default_factory=lambda: dict(_DEFAULT_GUARDS)
+    )
     severity_overrides: dict = field(default_factory=dict)
     baseline: str = "lint_baseline.json"
     default_paths: tuple = ("fuzzyheavyhitters_tpu", "tests")
 
 
-_KV_RE = re.compile(r"^\s*([A-Za-z0-9_\-\"']+)\s*=\s*(.+?)\s*$")
+_KV_RE = re.compile(r"^\s*([A-Za-z0-9_\-.\"']+)\s*=\s*(.+?)\s*$")
 _HDR_RE = re.compile(r"^\s*\[([^\]]+)\]\s*$")
 
 
@@ -230,6 +280,7 @@ def load_config(root: str | None = None, pyproject: str | None = None) -> LintCo
         "await_modules",
         "readback_modules",
         "queue_modules",
+        "race_modules",
         "default_paths",
     ):
         val = section.get(key)
@@ -237,6 +288,15 @@ def load_config(root: str | None = None, pyproject: str | None = None) -> LintCo
             setattr(cfg, key, tuple(val))
     if isinstance(section.get("baseline"), str):
         cfg.baseline = section["baseline"]
+    guards = section.get("guards")
+    if isinstance(guards, dict):
+        # the table REPLACES the default map (a merge could never retire
+        # a default binding from pyproject alone)
+        cfg.guards = {
+            k: v
+            for k, v in guards.items()
+            if isinstance(k, str) and isinstance(v, str)
+        }
     sev = section.get("severity")
     if isinstance(sev, dict):
         cfg.severity_overrides = {

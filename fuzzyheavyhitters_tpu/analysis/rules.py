@@ -1,7 +1,9 @@
 """The fhh-lint rule set, tuned to this codebase's invariants.
 
-Nine rules over eight concerns (the broad-except/bare-print concern
-ships as two rules so suppressions and severities stay per-rule):
+Eleven rules over ten concerns (the broad-except/bare-print concern
+ships as two rules so suppressions and severities stay per-rule; the
+two interprocedural fhh-race rules live in :mod:`.concurrency` and are
+registered here):
 
 - ``host-sync-in-hot-loop`` — device->host synchronization primitives
   (``.item()``, ``np.asarray``, ``jax.device_get``,
@@ -59,12 +61,21 @@ ships as two rules so suppressions and severities stay per-rule):
   into OOM — the exact failure class the admission-controlled front
   door exists to prevent; every buffer is bounded or carries an inline
   suppression proving it is bounded by construction.
+- ``guarded-state-unlocked`` / ``stale-read-across-await`` — the
+  fhh-race pair (:mod:`.concurrency`): interprocedural asyncio
+  lock-discipline over the declared guard map
+  (``[tool.fhh-lint.guards]`` + inline ``# fhh-guard:``), and the
+  snapshot-await-use atomicity break that every review round since the
+  pipelined crawl has hand-caught.  Validated dynamically by the
+  ``FHH_DEBUG_GUARDS=1`` runtime sanitizer
+  (:mod:`fuzzyheavyhitters_tpu.utils.guards`).
 """
 
 from __future__ import annotations
 
 import ast
 
+from .concurrency import RACE_RULES
 from .engine import Rule, SourceModule, dotted_name, last_segment
 
 # ---------------------------------------------------------------------------
@@ -870,6 +881,8 @@ ALL_RULES: tuple[Rule, ...] = (
     ChunkedDeviceReadback(),
     UnboundedAwait(),
     UnboundedQueue(),
+    # the interprocedural fhh-race pair (analysis/concurrency.py)
+    *RACE_RULES,
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -21,8 +21,8 @@ _DIR = os.path.dirname(__file__)
 _SRC = os.path.join(_DIR, "reservoir.cc")
 _LIB = os.path.join(_DIR, "libreservoir.so")
 _lock = threading.Lock()
-_lib = None
-_build_failed = False
+_lib = None  # fhh-guard: _lib=_lock
+_build_failed = False  # fhh-guard: _build_failed=_lock
 
 
 def _load():
@@ -85,7 +85,7 @@ def _load():
             # reservoir ABI — treat like no native library at all (the
             # pure-Python twin below is bit-identical)
             _build_failed = True
-    return _lib
+        return _lib
 
 
 def available() -> bool:

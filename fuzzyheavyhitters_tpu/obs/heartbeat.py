@@ -58,7 +58,7 @@ class Heartbeat(threading.Thread):
 
 
 _hb_lock = threading.Lock()
-_hb: Heartbeat | None = None
+_hb: Heartbeat | None = None  # fhh-guard: _hb=_hb_lock
 
 
 def start_heartbeat(default_s: float = 30.0) -> Heartbeat | None:

@@ -43,7 +43,7 @@ _cfg = {
         os.environ.get("FHH_LOG_LEVEL", "info"), 20
     ),
 }
-_opened: dict = {"path": None, "file": None}
+_opened: dict = {"path": None, "file": None}  # fhh-guard: _opened=_lock
 
 
 def configure(fmt: str | None = None, stream=None, min_severity: str | None = None):

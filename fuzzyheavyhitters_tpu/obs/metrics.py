@@ -67,12 +67,12 @@ _REGISTRIES: "weakref.WeakSet[Registry]" = weakref.WeakSet()
 # list(_REGISTRIES) in all_registries) — a plain Lock would deadlock that
 # thread against itself
 _GLOBAL_LOCK = threading.RLock()
-_DEFAULT: "Registry | None" = None
-_NEXT_SEQ = 0
+_DEFAULT: "Registry | None" = None  # fhh-guard: _DEFAULT=_GLOBAL_LOCK
+_NEXT_SEQ = 0  # fhh-guard: _NEXT_SEQ=_GLOBAL_LOCK
 # final snapshots of dropped registries, as (name, seq, report) — bounded
 _MAX_FINAL = 128
-_FINAL: "list[tuple[str, int, dict]]" = []
-_FINAL_DROPPED = 0
+_FINAL: "list[tuple[str, int, dict]]" = []  # fhh-guard: _FINAL=_GLOBAL_LOCK
+_FINAL_DROPPED = 0  # fhh-guard: _FINAL_DROPPED=_GLOBAL_LOCK
 
 
 def _retain_final(name: str, seq: int, counters, gauges, timers) -> None:
@@ -271,13 +271,15 @@ class _SpanCtx:
 def default_registry() -> Registry:
     """The process-wide registry for components without their own."""
     global _DEFAULT
-    if _DEFAULT is None:
-        reg = Registry("main")  # registers itself; do it outside the
-        # global lock (Registry.__init__ takes that same lock)
-        with _GLOBAL_LOCK:
-            if _DEFAULT is None:
-                _DEFAULT = reg
-    return _DEFAULT
+    with _GLOBAL_LOCK:
+        if _DEFAULT is not None:
+            return _DEFAULT
+    reg = Registry("main")  # registers itself; construct outside the
+    # global lock (Registry.__init__ takes that same lock)
+    with _GLOBAL_LOCK:
+        if _DEFAULT is None:  # lost the construction race: first one wins
+            _DEFAULT = reg
+        return _DEFAULT
 
 
 def all_registries() -> list[Registry]:

@@ -22,6 +22,7 @@ from ..obs import metrics as obsmetrics
 from ..ops.fields import F255, FE62
 from ..ops.ibdcf import IbDcfKeyBatch
 from ..resilience import policy as respolicy
+from ..utils import guards
 from ..utils.config import Config
 from . import collect
 from .driver import CrawlResult
@@ -836,6 +837,17 @@ class IngestOverloadedError(RuntimeError):
     help, the caller must slow down or drop."""
 
 
+# Runtime twin of the fhh-race guard map — the "WindowedIngest.*"
+# entries of pyproject [tool.fhh-lint.guards] (drift-tested in
+# tests/test_concurrency.py); see rpc._SERVER_GUARDS for the contract.
+_INGEST_GUARDS = {
+    "window": "_submit_lock",
+    "_journal": "_submit_lock",
+    "_journaled": "_submit_lock",
+    "_sealed": "_submit_lock",
+}
+
+
 class WindowedIngest:
     """Leader-side driver of the streaming front door: continuous
     ``submit_keys`` into tumbling windows, ``seal_window`` at each
@@ -890,12 +902,24 @@ class WindowedIngest:
         for i, c in ((0, lead.c0), (1, lead.c1)):
             if lead._boot_ids.get(i) is None:
                 lead._boot_ids[i] = getattr(c, "boot_id", None)
+        # LAST: the sanitizer (a no-op unless FHH_DEBUG_GUARDS=1 or
+        # cfg.debug_guards) wraps the already-constructed guarded state
+        guards.install(
+            self, _INGEST_GUARDS,
+            force=bool(getattr(self.cfg, "debug_guards", False)),
+        )
 
     # -- window lifecycle -------------------------------------------------
 
+    # fhh-race: atomic (telemetry-only read of the window id for the span label: one event-loop slice, and a label one boundary stale costs nothing)
     def _ensure_span(self) -> None:
         if self._span_ctx is None:
-            self._span_ctx = self.obs.span("ingest", level=self.window)
+            with guards.unguarded(
+                "telemetry-only window-id read for the span label "
+                "(fhh-race atomic contract on _ensure_span)"
+            ):
+                w = self.window
+            self._span_ctx = self.obs.span("ingest", level=w)
             self._span_ctx.__enter__()
 
     def _exit_span(self) -> None:
@@ -1009,8 +1033,10 @@ class WindowedIngest:
                 )
             )
         if rec["shed"]:
+            # fhh-lint: disable=stale-read-across-await (deliberate snapshot: the stats must label the window this submission actually LANDED in — the admission-time id banked under the lock; a post-await re-read would mislabel it with whatever window is current now)
             self.obs.count("ingest_shed_subs", level=w)
         else:
+            # fhh-lint: disable=stale-read-across-await (deliberate snapshot, same contract as the shed branch: the admitted count labels the window this submission LANDED in — the id banked under the lock at gate time, not whatever window is current after the backoff awaits)
             self.obs.count("ingest_admitted", n_keys, level=w)
         return r0
 
@@ -1018,28 +1044,37 @@ class WindowedIngest:
         """Freeze the current window on both servers (tumbling-window
         boundary), bank the ingest checkpoint, and open the next window.
         Returns the gate's seal stats (keys/subs/shed/rejected)."""
-        w = self.window
         faults = 0
         while True:
-            # under the submit lock: the boundary must not race a
-            # half-mirrored submission (gate applied, mirror in flight)
+            # the WHOLE boundary — window-id read, seal pair, stats
+            # bank, window advance — under one submit-lock hold: it must
+            # not race a half-mirrored submission (gate applied, mirror
+            # in flight), and the id must be read UNDER the lock — a
+            # pre-lock read could re-seal a window a concurrent boundary
+            # already advanced past and ROLL THE WINDOW COUNTER BACK
+            # (fhh-race caught this: the PR-7 stale-window-id shape,
+            # this time on the seal path)
             async with self._submit_lock:
+                w = self.window
                 try:
                     r0, r1 = await self.lead._both(
                         "window_seal", {"window": w}
                     )
-                    break
                 except respolicy.TRANSIENT_ERRORS:
                     faults += 1
                     if faults > 8:
                         raise
                     await self._recover_ingest()
-        if (r0["keys"], r0["subs"]) != (r1["keys"], r1["subs"]):
-            raise RuntimeError(
-                f"window {w} pools diverged at seal: gate {r0} vs mirror {r1}"
-            )
+                    continue
+                if (r0["keys"], r0["subs"]) != (r1["keys"], r1["subs"]):
+                    raise RuntimeError(
+                        f"window {w} pools diverged at seal: "
+                        f"gate {r0} vs mirror {r1}"
+                    )
+                self._sealed[w] = r0
+                self.window = w + 1
+                break
         self._exit_span()
-        self._sealed[w] = r0
         # shed keys include reservoir-replaced occupants the driver
         # cannot see per-submit — the seal stats are authoritative
         self.obs.count("ingest_shed", int(r0["shed_keys"]), level=w)
@@ -1052,7 +1087,6 @@ class WindowedIngest:
             shed=int(r0["shed_keys"]),
             rejected=int(r0["rejected"]),
         )
-        self.window = w + 1
         if self._ckpt:
             # bank the pools at the boundary: the rollback point a
             # kill-mid-window recovers to (ingest-only blob, level -1)
@@ -1078,7 +1112,8 @@ class WindowedIngest:
         (checkpoint restore + journal replay), reloads the window, and
         re-runs its crawl — results stay bit-exact because the frozen
         pool is reconstructed exactly and the crawl is deterministic."""
-        stats = self._sealed.get(w)
+        async with self._submit_lock:
+            stats = self._sealed.get(w)
         if stats is None:
             raise RuntimeError(f"crawl_window: window {w} is not sealed")
         nreqs = int(stats["keys"])
@@ -1102,20 +1137,32 @@ class WindowedIngest:
                 if recoveries > max_recoveries:
                     raise
                 try:
-                    await self._recover_ingest()
+                    # under the submit lock (same _submit_lock ->
+                    # _recover_lock order as submit/seal): the journal
+                    # replay rebuilds pools POSITIONALLY, so a live
+                    # gate+mirror pair interleaving with it could land
+                    # between replayed records and diverge the two
+                    # servers' slot order (fhh-race caught the unlocked
+                    # form)
+                    async with self._submit_lock:
+                        await self._recover_ingest()
                 except respolicy.TRANSIENT_ERRORS:
                     # a server still coming back up: the next loop turn
                     # re-probes (bounded by max_recoveries)
                     continue
         # the window is crawled: its journal, journaled-id set, and seal
         # stats (and any earlier) are done — bounded driver memory
-        # mirrors the servers' bounded pools
-        for old in [k for k in self._journal if k <= w]:
-            for rec in self._journal[old]:
-                self._journaled.discard(rec["sub_id"])
-            del self._journal[old]
-        for old in [k for k in self._sealed if k <= w]:
-            del self._sealed[old]
+        # mirrors the servers' bounded pools.  Under the submit lock:
+        # the prune itself never suspends, but the discipline is that
+        # EVERY journal/seal-table access holds the lock, and a submit
+        # mid-await must not watch its window's records vanish
+        async with self._submit_lock:
+            for old in [k for k in self._journal if k <= w]:
+                for rec in self._journal[old]:
+                    self._journaled.discard(rec["sub_id"])
+                del self._journal[old]
+            for old in [k for k in self._sealed if k <= w]:
+                del self._sealed[old]
         return res
 
     async def _recover_ingest(self) -> None:

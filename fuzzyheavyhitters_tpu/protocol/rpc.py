@@ -86,6 +86,7 @@ from ..parallel import server_mesh as smesh
 from ..resilience import admission as resadmission
 from ..resilience import chaos as reschaos
 from ..resilience import policy as respolicy
+from ..utils import guards
 from ..utils.config import Config
 from . import collect, mpc, secure, sketch as sketchmod
 
@@ -404,6 +405,29 @@ class _WindowPool:
         }
 
 
+# Runtime twin of the fhh-race guard map — the "CollectorServer.*"
+# entries of pyproject [tool.fhh-lint.guards], attr -> owning asyncio
+# lock (drift-tested against the pyproject table in
+# tests/test_concurrency.py).  Under FHH_DEBUG_GUARDS=1 (or
+# Config.debug_guards) utils/guards.py arms a GuardedState descriptor
+# per entry, so every access asserts the lock is held by the current
+# task — the dynamic validation of the `# fhh-race: holds=` contracts
+# the static analyzer cannot see through _dispatch's dynamic getattr.
+_SERVER_GUARDS = {
+    "frontier": "_verb_lock",
+    "keys": "_verb_lock",
+    "keys_parts": "_verb_lock",
+    "alive_keys": "_verb_lock",
+    "_expand_ready": "_verb_lock",
+    "_ingest_pools": "_verb_lock",
+    "_admission": "_verb_lock",
+    "_sessions": "_verb_lock",
+    "_sketch_parts": "_verb_lock",
+    "_sketch_root": "_verb_lock",
+    "_ratchet_digest": "_verb_lock",
+}
+
+
 @dataclass
 class CollectorServer:
     """One collector server process (ref: server.rs:44-172).
@@ -501,10 +525,13 @@ class CollectorServer:
                 shed=self.cfg.ingest_shed,
                 seed=self.cfg.ingest_seed,
             )
+        # LAST: the sanitizer (a no-op unless FHH_DEBUG_GUARDS=1 or
+        # cfg.debug_guards) wraps the already-constructed guarded state
+        guards.install(self, _SERVER_GUARDS, force=self.cfg.debug_guards)
 
     # -- verbs (ref: rpc.rs:56-66) ---------------------------------------
 
-    async def reset(self, _req) -> bool:
+    async def reset(self, _req) -> bool:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
         self.keys_parts.clear()
         self.keys = None
         self.alive_keys = None
@@ -533,7 +560,7 @@ class CollectorServer:
             ).copy()
         return True
 
-    async def add_keys(self, req) -> bool:
+    async def add_keys(self, req) -> bool:  # fhh-race: atomic (unlocked upload fast path: append-only, never suspends — many in-flight batches deserialize concurrently by design)
         """req: pytree-of-arrays key batch chunk [B, d, 2] (the tensor form
         of AddKeysRequest, ref: rpc.rs:13-15).  An optional ``sketch`` entry
         carries the clients' malicious-security material (MAC'd payload
@@ -571,7 +598,7 @@ class CollectorServer:
             self._mesh.bind(self.keys.cw_seed.shape[0])
             self.keys = self._mesh.shard_keys(self.keys)
 
-    async def tree_init(self, req) -> bool:
+    async def tree_init(self, req) -> bool:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
         if not self.keys_parts:
             raise RuntimeError("tree_init before add_keys")
         root_bucket = int((req or {}).get("root_bucket", 1))
@@ -633,7 +660,7 @@ class CollectorServer:
             self._sketch_root, level, self._ratchet_digest
         )
 
-    async def sketch_verify(self, req) -> np.ndarray:
+    async def sketch_verify(self, req) -> np.ndarray:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
         """Malicious-security check (ref intent: the TreeSketchFrontier*
         verb vestiges rpc.rs:40-51, gate at collect.rs:495): sketch inner
         products + Beaver verification over the peer data plane, per
@@ -858,7 +885,7 @@ class CollectorServer:
             field_s=field.seconds,
         )
 
-    def _shard_frontier(self, shard):
+    def _shard_frontier(self, shard):  # fhh-race: atomic (pure slice of the frontier, never suspends; reached from the frame-arrival pre-expand)
         """The frontier view one crawl verb works on: the whole frontier
         (``shard`` None) or the node span ``[lo, hi)`` of it.  Both
         servers receive identical shard spans from the leader, so their
@@ -896,7 +923,7 @@ class CollectorServer:
     # GC/OT network phase with span k+1's device compute (the leader
     # keeps both frames in flight via ``crawl_pipeline_depth``).
 
-    def _do_expand(self, level: int, last: bool, shard) -> dict:
+    def _do_expand(self, level: int, last: bool, shard) -> dict:  # fhh-race: atomic (dispatch-only device work, never suspends; called both under the verb lock and from the frame-arrival pre-expand)
         """Device half of one crawl span: dispatch-only (no sync — a
         block_until_ready here would cost a tunnel RTT); pure function of
         (keys, frontier, level, span), so a shard re-run may reuse it
@@ -935,7 +962,7 @@ class CollectorServer:
             return hit
         return self._do_expand(level, last, shard)
 
-    def _maybe_pre_expand(self, verb: str, req) -> None:
+    def _maybe_pre_expand(self, verb: str, req) -> None:  # fhh-race: atomic (frame-arrival prefetch: reads frontier/keys and stashes in one event-loop slice; every frontier mutation clears the stash before the next slice)
         """Frame-arrival hook (``_dispatch``, BEFORE the verb lock): run
         the expand stage for a sharded crawl verb while earlier spans
         still hold the lock.  Purely an overlap optimization — any
@@ -1237,7 +1264,7 @@ class CollectorServer:
             error=str(err),
         )
 
-    async def tree_crawl(self, req) -> np.ndarray:
+    async def tree_crawl(self, req) -> np.ndarray:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
         """-> FE62 shares of per-child counts [F, 2^d] (ref: rpc.rs:60).
         An optional ``shard: (lo, hi)`` restricts the crawl to that node
         span (mid-level retry granularity — the leader assembles)."""
@@ -1267,7 +1294,7 @@ class CollectorServer:
             return FE62.np_add(counts.astype(np.uint64), r)
         return r
 
-    async def tree_crawl_last(self, req) -> np.ndarray:
+    async def tree_crawl_last(self, req) -> np.ndarray:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
         """-> F255 shares [F, 2^d, 8] for the final level (ref: rpc.rs:61,
         collect.rs:775-916 — BlockPair double-block OT payloads in secure
         mode).  Shares are retained for final_shares re-serving; sharded
@@ -1303,7 +1330,7 @@ class CollectorServer:
             self._shard_last[int(shard[0])] = shares
         return shares
 
-    async def tree_prune(self, req) -> bool:
+    async def tree_prune(self, req) -> bool:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
         """Fused prune+advance: materialize surviving children
         (ref: rpc.rs:63 tree_prune + collect.rs:918-929).  The sketch DPF
         states advance with the same survivor table."""
@@ -1352,7 +1379,7 @@ class CollectorServer:
         self._shard_children.clear()
         return children
 
-    async def tree_prune_last(self, req) -> bool:
+    async def tree_prune_last(self, req) -> bool:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
         """Last level keeps no child count states to advance — compact the
         stored leaf count shares down to the survivors
         (ref: collect.rs:931-942).  The sketch DPF does advance once more
@@ -1393,7 +1420,7 @@ class CollectorServer:
         )
         return True
 
-    async def final_shares(self, req) -> dict:
+    async def final_shares(self, req) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
         """Re-serve the surviving leaves' count shares for leader-side
         reconstruction (ref: rpc.rs:65, collect.rs:993-1004; tree paths
         live with the leader in this design, see protocol/collect.py)."""
@@ -1402,7 +1429,7 @@ class CollectorServer:
     # -- streaming ingest front door (ROADMAP "Streaming ingestion": the
     # online successor of the one-shot add_keys upload) ------------------
 
-    def _ingest_pool(self, window: int) -> _WindowPool:
+    def _ingest_pool(self, window: int) -> _WindowPool:  # fhh-race: atomic (create-or-get + bounded eviction in one event-loop slice; called from the unlocked ingest fast path and from locked verbs)
         """Create-or-get the pool for ``window``; live-window count is
         BOUNDED (``cfg.ingest_windows_retained``) so a runaway window id
         can never grow server memory — the refusal is loud, never a
@@ -1436,7 +1463,7 @@ class CollectorServer:
             )
         return pool
 
-    async def submit_keys(self, req) -> dict:
+    async def submit_keys(self, req) -> dict:  # fhh-race: atomic (unlocked ingest fast path: never suspends, so admission+append is one event-loop slice; rides concurrently with a crawl HOLDING the verb lock — that concurrency is the front door's whole point)
         """Streaming key submission into the named window's pool —
         admission-controlled, append-only, idempotent per ``sub_id``.
 
@@ -1498,7 +1525,7 @@ class CollectorServer:
             self.obs.count("pool_rejected")
         return resp
 
-    async def window_seal(self, req) -> dict:
+    async def window_seal(self, req) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
         """Freeze the named window at its boundary: no further
         submissions land in it (later ``submit_keys`` name later
         windows); returns the pool stats.  Idempotent — re-sealing a
@@ -1520,7 +1547,7 @@ class CollectorServer:
             )
         return pool.stats()
 
-    async def window_load(self, req) -> dict:
+    async def window_load(self, req) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
         """Materialize a SEALED window's frozen pool as the crawl's key
         batch (the streaming twin of the ``add_keys`` upload): the crawl
         state resets to empty, ``keys_parts`` becomes the pool's
@@ -1585,7 +1612,7 @@ class CollectorServer:
     # -- resilience verbs (no reference analogue: the reference's only
     # recovery verb is reset, server.rs:64-69) ---------------------------
 
-    async def status(self, _req) -> dict:
+    async def status(self, _req) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
         """Cheap probe for the supervising leader: the boot id tells a
         reconnecting leader whether this is the same process (replay is
         safe) or a restart (state is gone — restore path), and the dedup
@@ -1682,7 +1709,7 @@ class CollectorServer:
         h.update(np.ascontiguousarray(np.asarray(self.keys.root_seed)))
         return np.frombuffer(h.digest(), np.uint8)
 
-    async def tree_checkpoint(self, req) -> dict:
+    async def tree_checkpoint(self, req) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
         """Persist the crawl state AFTER the given level completed:
         frontier eval states + node liveness + client liveness + the
         state layout flag (planar Pallas vs interleaved XLA — a restore
@@ -1937,7 +1964,7 @@ class CollectorServer:
                 wa.reservoir = Reservoir.from_state(rec["res"])
             self._ingest_pools[w] = pool
 
-    async def tree_restore(self, req) -> dict:
+    async def tree_restore(self, req) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
         """Reload the :meth:`tree_checkpoint` for the level the leader
         names; returns the completed level so the leader re-runs from
         ``level + 1``.  Requires keys: either still held (transient
@@ -2119,7 +2146,7 @@ class CollectorServer:
         )
         return {"level": level}
 
-    async def plane_reset(self, _req) -> bool:
+    async def plane_reset(self, _req) -> bool:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
         """Re-establish the server↔server data plane after a peer loss.
 
         Only the DIALER (server 0) acts: it drops the dead transport and
@@ -2155,7 +2182,7 @@ class CollectorServer:
         obs.emit("resilience.plane_break", server=self.server_id)
         return True
 
-    async def warmup(self, req) -> dict:
+    async def warmup(self, req) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
         """Pre-compile the per-``f_bucket`` crawl programs so bucket
         recompiles stop billing into measured (or production) crawl time:
         for every requested bucket (and every shard-span size it implies
@@ -2319,7 +2346,7 @@ class CollectorServer:
         "warmup",  # per-f_bucket compile warmup (no protocol state)
     )
 
-    def _bind_session(self, req) -> _Session | None:
+    def _bind_session(self, req) -> _Session | None:  # fhh-race: atomic (serve-loop session table: create-or-attach + eviction never suspends; all connections share one event loop)
         """Create-or-attach the leader session named in a ``__hello__``.
         Sessions are bounded (oldest-idle evicted) so reconnecting leaders
         with fresh session ids cannot grow server memory without bound."""
@@ -2383,11 +2410,19 @@ class CollectorServer:
                 # verb wedged on the data plane while HOLDING the lock
                 # (pipelined quiesce) — behind the lock it could never
                 # run.
-                resp = await getattr(self, verb)(req)
+                with guards.unguarded(
+                    "unlocked fast-path verb: event-loop-atomic by the "
+                    "fhh-race atomic contracts on add_keys/submit_keys"
+                ):
+                    resp = await getattr(self, verb)(req)
             else:
                 # frame-arrival expand stage: overlap a sharded crawl's
                 # device work with the span currently holding the lock
-                self._maybe_pre_expand(verb, req)
+                with guards.unguarded(
+                    "frame-arrival prefetch: event-loop-atomic by the "
+                    "fhh-race atomic contract on _maybe_pre_expand"
+                ):
+                    self._maybe_pre_expand(verb, req)
                 async with self._verb_lock:
                     resp = await getattr(self, verb)(req)
         # fhh-lint: disable=broad-except (RPC boundary: EVERY failure
@@ -2460,7 +2495,11 @@ class CollectorServer:
                     count=lambda n: self.obs.count("control_bytes_recv", n),
                 )
                 if verb == "__hello__":
-                    sess = self._bind_session(req)
+                    with guards.unguarded(
+                        "serve-loop session bind: event-loop-atomic by "
+                        "the fhh-race atomic contract on _bind_session"
+                    ):
+                        sess = self._bind_session(req)
                     await respond(
                         req_id,
                         {"boot_id": self._boot_id, "server_id": self.server_id},

@@ -38,8 +38,8 @@ _enabled: str | None = None
 # report a delta of ZERO (a per-batch static arg, a fresh jit wrapper
 # per call, or a warmup coverage hole all break that loudly).
 _compile_lock = threading.Lock()
-_compile_count = 0
-_listener_on = False
+_compile_count = 0  # fhh-guard: _compile_count=_compile_lock
+_listener_on = False  # fhh-guard: _listener_on=_compile_lock
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 

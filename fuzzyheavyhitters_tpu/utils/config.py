@@ -130,6 +130,12 @@ class Config:
     # window being crawled + the window(s) still accruing); bounds
     # server memory against a runaway window id
     ingest_windows_retained: int = 4
+    # arm the fhh-race runtime sanitizer (utils/guards.py) on this
+    # process's servers/drivers regardless of FHH_DEBUG_GUARDS — every
+    # guarded-attribute access then asserts its owning lock is held by
+    # the current task.  Debug/chaos-suite instrumentation, never a
+    # production knob: attribute access gains a descriptor hop
+    debug_guards: bool = False
 
 
 def load_config(path: str) -> Config:

@@ -1,0 +1,231 @@
+"""Runtime lock-discipline sanitizer: the dynamic half of fhh-race.
+
+The static analyzer (:mod:`fuzzyheavyhitters_tpu.analysis.concurrency`)
+proves the declared guard map — each shared attribute bound to the
+asyncio lock that owns it — but its ``# fhh-race: holds=<lock>``
+contracts on dynamically-dispatched verbs are *declarations* the AST
+cannot verify.  This module verifies them at runtime, sanitizer-style:
+under ``FHH_DEBUG_GUARDS=1`` every access to a guarded attribute asserts
+that the owning lock is held *by the current task* at that moment, so
+the existing tier-1 e2e + chaos suites exercise the contracts on every
+verb, replay, recovery, and fault-injection path they already cover.
+
+Off by default, zero overhead when off: :func:`install` is a no-op
+unless enabled, leaving the instance's class — and therefore every
+attribute access — untouched.  When enabled it swaps the instance onto
+a dynamically-built subclass whose :class:`GuardedState` descriptors
+wrap each guarded attribute, and wraps the named locks' ``acquire`` /
+``release`` (instance-level, which ``async with`` reaches via the
+mixin's ``self.acquire()``) to track the owning task.
+
+Deliberately-unlocked windows — the event-loop-atomic ingest fast path,
+the frame-arrival pre-expand — run inside :func:`unguarded`, whose
+required ``reason`` is the runtime twin of the written justification on
+the static suppression at the same site.
+
+Scope: asyncio locks only.  The threading-locked module globals in
+``obs/`` / ``native/`` are covered statically (inline ``# fhh-guard:``
+annotations); their C-level locks admit no ownership hook.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import contextvars
+import os
+
+__all__ = [
+    "GuardViolation",
+    "GuardedState",
+    "enabled",
+    "install",
+    "unguarded",
+]
+
+_ENV = "FHH_DEBUG_GUARDS"
+
+# True once ANY instance armed in this process: the unguarded() windows
+# on the hot dispatch path reduce to one global bool check until then
+_armed = False
+
+# depth of deliberately-unlocked windows for the current task/context
+# (contextvars so one task's window never blesses a neighbour's access)
+_unguarded_depth: contextvars.ContextVar[int] = contextvars.ContextVar(
+    "fhh_unguarded_depth", default=0
+)
+
+
+class GuardViolation(AssertionError):
+    """A guarded attribute was touched without its owning lock held by
+    the current task.  Subclasses AssertionError so test suites that
+    treat assertion failures as hard failures catch it without new
+    plumbing."""
+
+
+def enabled() -> bool:
+    """True when the sanitizer is switched on (``FHH_DEBUG_GUARDS=1``).
+    Read per call — :func:`install` runs at server/driver construction,
+    so a test flipping the env var before construction gets the mode it
+    asked for."""
+    return os.environ.get(_ENV, "") == "1"
+
+
+# shared no-op window for the disarmed path: nullcontext is stateless,
+# reusable, and reentrant, so every call returns THIS instance — the
+# verb-dispatch hot path pays one function call + bool check, never a
+# generator-context-manager allocation
+_NOOP = contextlib.nullcontext()
+
+
+class _UnguardedWindow:
+    """Armed-path window: bumps the per-task unguarded depth for the
+    body.  A fresh instance per entry (the token is per-with)."""
+
+    __slots__ = ("_token",)
+
+    def __enter__(self):
+        self._token = _unguarded_depth.set(_unguarded_depth.get() + 1)
+        return None
+
+    def __exit__(self, *exc):
+        _unguarded_depth.reset(self._token)
+        return False
+
+
+def unguarded(reason: str):
+    """Suspend guard assertions for the current task while the body
+    runs.  ``reason`` is mandatory and non-empty: every runtime window
+    mirrors a written justification on the static suppression at the
+    same site (grep for the reason text to find its twin)."""
+    if not reason or not reason.strip():
+        raise ValueError("unguarded() requires a written reason")
+    if not _armed:  # sanitizer never armed: stay off the contextvar
+        return _NOOP
+    return _UnguardedWindow()
+
+
+def _current_task():
+    try:
+        return asyncio.current_task()
+    except RuntimeError:  # no running loop (sync caller, worker thread)
+        return None
+
+
+def _track_ownership(lock) -> None:
+    """Wrap ``lock.acquire``/``lock.release`` (idempotently) so the lock
+    remembers which task holds it.  Instance-level wrapping suffices:
+    asyncio's ``_ContextManagerMixin.__aenter__`` calls ``self.acquire()``,
+    an instance lookup."""
+    if getattr(lock, "_fhh_tracked", False):
+        return
+    orig_acquire, orig_release = lock.acquire, lock.release
+
+    async def acquire(*a, **kw):
+        ok = await orig_acquire(*a, **kw)
+        lock._fhh_owner = _current_task()
+        return ok
+
+    def release(*a, **kw):
+        lock._fhh_owner = None
+        return orig_release(*a, **kw)
+
+    lock.acquire = acquire
+    lock.release = release
+    lock._fhh_owner = None
+    lock._fhh_tracked = True
+
+
+class GuardedState:
+    """Descriptor asserting lock ownership on every get/set/delete of
+    one guarded attribute.  The value itself lives in the instance
+    ``__dict__`` under a shadow key, so installing the descriptor
+    preserves the already-constructed state."""
+
+    __slots__ = ("name", "lock_name", "_shadow")
+
+    def __init__(self, name: str, lock_name: str):
+        self.name = name
+        self.lock_name = lock_name
+        self._shadow = f"_fhh_guarded__{name}"
+
+    def _check(self, obj) -> None:
+        if _unguarded_depth.get():
+            return
+        lock = obj.__dict__.get(self.lock_name)
+        if lock is None:
+            lock = getattr(obj, self.lock_name, None)
+        if lock is None:
+            return  # lock not constructed yet: construction-time access
+        if not lock.locked():
+            raise GuardViolation(
+                f"guarded attribute '{type(obj).__name__}.{self.name}' "
+                f"accessed with its owning lock '{self.lock_name}' not "
+                "held (fhh-race contract violation — hold the lock or "
+                "open a guards.unguarded(reason) window)"
+            )
+        owner = getattr(lock, "_fhh_owner", None)
+        task = _current_task()
+        if owner is not None and task is not None and owner is not task:
+            raise GuardViolation(
+                f"guarded attribute '{type(obj).__name__}.{self.name}' "
+                f"accessed while '{self.lock_name}' is held by ANOTHER "
+                "task (fhh-race contract violation)"
+            )
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        self._check(obj)
+        try:
+            return obj.__dict__[self._shadow]
+        except KeyError:
+            raise AttributeError(self.name) from None
+
+    def __set__(self, obj, value):
+        self._check(obj)
+        obj.__dict__[self._shadow] = value
+
+    def __delete__(self, obj):
+        self._check(obj)
+        try:
+            del obj.__dict__[self._shadow]
+        except KeyError:
+            raise AttributeError(self.name) from None
+
+
+def install(obj, guard_map: dict, force: bool = False) -> bool:
+    """Arm the sanitizer on one instance: when :func:`enabled` (or
+    ``force=True`` — the ``Config.debug_guards`` knob), swap ``obj``
+    onto a per-call subclass carrying a :class:`GuardedState` per
+    ``guard_map`` entry (``attr -> lock attribute``) and arm ownership
+    tracking on each named lock.  Call at the END of construction —
+    guarded attributes must already exist.  Returns whether the
+    sanitizer was armed (False = disabled: ``obj`` is untouched,
+    attribute access cost is unchanged)."""
+    global _armed
+    if (not enabled() and not force) or not guard_map:
+        return False
+    _armed = True
+    cls = type(obj)
+    if getattr(cls, "_fhh_guards_installed", False):
+        return True  # already armed (re-entrant install)
+    descriptors = {
+        attr: GuardedState(attr, lock_name)
+        for attr, lock_name in guard_map.items()
+    }
+    for attr, desc in descriptors.items():
+        # move the live value under the shadow key the descriptor reads
+        if attr in obj.__dict__:
+            obj.__dict__[desc._shadow] = obj.__dict__.pop(attr)
+    shadow_cls = type(
+        f"Guarded{cls.__name__}",
+        (cls,),
+        {**descriptors, "_fhh_guards_installed": True},
+    )
+    obj.__class__ = shadow_cls
+    for lock_name in set(guard_map.values()):
+        lock = getattr(obj, lock_name, None)
+        if lock is not None:
+            _track_ownership(lock)
+    return True

@@ -32,7 +32,22 @@ JAX_PLATFORMS=cpu python -m pytest \
     -p no:cacheprovider --junitxml="$report"
 rc=$?
 
-python - "$report" "$artifact" <<'EOF'
+# fhh-race runtime sanitizer stage: re-run one trusted + one secure e2e
+# chaos recovery scenario with FHH_DEBUG_GUARDS=1, so every guarded-
+# attribute access on the servers asserts its owning lock mid-fault —
+# the dynamic validation of the static guard map under real chaos
+# (utils/guards.py; the scenarios flow through the socket verb path, so
+# the lock discipline is exactly the production one)
+JAX_PLATFORMS=cpu FHH_DEBUG_GUARDS=1 python -m pytest \
+    "tests/test_resilience.py::test_e2e_chaos_recovery_bit_identical" \
+    -q -p no:cacheprovider
+guards_rc=$?
+if [ $guards_rc -ne 0 ]; then
+    echo "chaos suite: FHH_DEBUG_GUARDS sanitizer stage FAILED" >&2
+    rc=1
+fi
+
+python - "$report" "$artifact" "$guards_rc" <<'EOF'
 import json, sys
 import xml.etree.ElementTree as ET
 
@@ -54,12 +69,14 @@ doc = {
     "failed": sum(t["outcome"] == "failed" for t in tests),
     "skipped": sum(t["outcome"] == "skipped" for t in tests),
     "duration_s": round(float(suite.get("time", 0)), 2),
+    "debug_guards": "passed" if sys.argv[3] == "0" else "failed",
     "tests": tests,
 }
 json.dump(doc, open(sys.argv[2], "w"), indent=1)
 print(
     f"chaos suite: {doc['passed']} passed, {doc['failed']} failed, "
-    f"{doc['skipped']} skipped in {doc['duration_s']}s -> {sys.argv[2]}"
+    f"{doc['skipped']} skipped in {doc['duration_s']}s, "
+    f"debug_guards={doc['debug_guards']} -> {sys.argv[2]}"
 )
 EOF
 rm -f "$report"

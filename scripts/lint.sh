@@ -20,10 +20,18 @@ python -m fuzzyheavyhitters_tpu.analysis \
 python - "$artifact" <<'EOF'
 import json, sys
 doc = json.load(open(sys.argv[1]))
+# the artifact must prove the interprocedural fhh-race pass ran (the
+# rule list is part of the report schema exactly for this assert)
+race = {"guarded-state-unlocked", "stale-read-across-await"}
+missing = race - set(doc.get("rules", []))
+if missing:
+    print(f"fhh-lint: fhh-race pass MISSING from artifact: {sorted(missing)}")
+    sys.exit(1)
 print(
     f"fhh-lint: {len(doc['findings'])} new, "
     f"{doc['baselined']} baselined, "
-    f"{len(doc['stale_baseline'])} stale baseline entries "
+    f"{len(doc['stale_baseline'])} stale baseline entries, "
+    f"fhh-race pass active "
     f"-> {sys.argv[1]}"
 )
 EOF

@@ -703,7 +703,7 @@ def test_pyproject_and_dataclass_defaults_do_not_drift():
         "hot_modules", "hot_roots", "secret_lexicon", "sink_calls",
         "print_scope", "print_allowed", "shared_state_modules",
         "await_modules", "readback_modules", "queue_modules",
-        "default_paths", "baseline",
+        "race_modules", "guards", "default_paths", "baseline",
     ):
         assert getattr(operative, key) == getattr(defaults, key), key
 
@@ -831,8 +831,9 @@ def test_chunked_readback_device_side_asarray_clean():
 
 
 def test_every_rule_has_fixture_coverage():
-    """Each shipped rule appears in at least one positive fixture above —
-    guards against a rule being added but never exercised."""
+    """Each shipped rule appears in at least one positive fixture — here,
+    or (the fhh-race pair) in tests/test_concurrency.py — guards against
+    a rule being added but never exercised."""
     covered = {
         "host-sync-in-hot-loop",
         "secret-to-sink",
@@ -843,6 +844,9 @@ def test_every_rule_has_fixture_coverage():
         "chunked-device-readback",
         "unbounded-await",
         "unbounded-queue",
+        # fixtures in tests/test_concurrency.py
+        "guarded-state-unlocked",
+        "stale-read-across-await",
     }
     assert {r.name for r in ALL_RULES} == covered
 

@@ -1,0 +1,744 @@
+"""fhh-race: the interprocedural lock-discipline analyzer and its
+runtime sanitizer twin.
+
+Static half (analysis/concurrency.py): positive/negative fixtures per
+rule — locked vs unlocked guarded access, transitive callee lock
+inheritance through the module call graph, declared ``holds=`` dispatch
+contracts, the VERIFIED ``atomic`` contract (flags the moment an await
+appears), await-straddling snapshot reads including a reconstruction of
+the PR-7 stale-window-id shape, released-then-reacquired locks, inline
+module-global guards, scope, and suppressions — plus the repo
+self-analysis-at-zero tier-1 gate and the guard-map drift tests tying
+pyproject, LintConfig, and the runtime twin tables together.
+
+Runtime half (utils/guards.py): GuardedState assertion semantics
+(unlocked access raises, lock-held access passes, cross-task ownership
+raises, ``unguarded(reason)`` windows), the off-by-default no-overhead
+contract, arming via FHH_DEBUG_GUARDS and Config.debug_guards, a
+sanitizer-armed CollectorServer raising on a deliberately unguarded
+verb call, the seal-window concurrency regression the analyzer caught,
+and a full socket e2e crawl running green with the sanitizer armed.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from fuzzyheavyhitters_tpu.analysis import (
+    LintConfig,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    load_config,
+)
+from fuzzyheavyhitters_tpu.analysis.baseline import removed_rules
+from fuzzyheavyhitters_tpu.analysis.rules import RULES_BY_NAME
+from fuzzyheavyhitters_tpu.ops import ibdcf
+from fuzzyheavyhitters_tpu.protocol import leader_rpc, rpc
+from fuzzyheavyhitters_tpu.protocol.leader_rpc import RpcLeader, WindowedIngest
+from fuzzyheavyhitters_tpu.utils import bits as bitutils
+from fuzzyheavyhitters_tpu.utils import guards
+from fuzzyheavyhitters_tpu.utils.config import Config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASE_PORT = 42331
+
+RACE_RULE_NAMES = ("guarded-state-unlocked", "stale-read-across-await")
+
+
+@pytest.fixture(autouse=True)
+def _module_cpu(cpu_default):
+    """CPU backend: the sanitizer e2e exercises the same host-side RPC
+    glue as test_rpc; its device programs are the shared crawl kernels."""
+    yield
+
+
+def _race(src, guard_map=None, rule=None,
+          relpath="fuzzyheavyhitters_tpu/protocol/fake.py"):
+    cfg = LintConfig()
+    if guard_map is not None:
+        cfg.guards = dict(guard_map)
+    rules = (
+        [RULES_BY_NAME[rule]]
+        if rule
+        else [RULES_BY_NAME[r] for r in RACE_RULE_NAMES]
+    )
+    return lint_source(textwrap.dedent(src), relpath, cfg, rules)
+
+
+def _names(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# rule: guarded-state-unlocked — lexical locks, call-graph inheritance,
+# declared contracts
+# ---------------------------------------------------------------------------
+
+
+def test_unlocked_access_detected_locked_access_clean():
+    src = """
+    import asyncio
+    class Srv:
+        def __init__(self):
+            self._lk = asyncio.Lock()
+            self.state = 0
+        async def bad(self):
+            return self.state
+        async def good(self):
+            async with self._lk:
+                return self.state
+    """
+    fs = _race(src, {"Srv.state": "_lk"}, rule="guarded-state-unlocked")
+    assert _names(fs) == ["guarded-state-unlocked"]
+    assert "Srv.bad" in fs[0].message and "'_lk'" in fs[0].message
+
+
+def test_constructor_access_is_exempt():
+    src = """
+    import asyncio
+    class Srv:
+        def __init__(self):
+            self._lk = asyncio.Lock()
+            self.state = 0
+            self.state += 1
+    """
+    assert _race(src, {"Srv.state": "_lk"}) == []
+
+
+def test_transitive_callee_inherits_callers_locks():
+    """A helper reached ONLY from inside lock blocks inherits them; the
+    same helper also reached from an unlocked caller does not."""
+    clean = """
+    import asyncio
+    class Srv:
+        def __init__(self):
+            self._lk = asyncio.Lock()
+            self.state = 0
+        async def verb(self):
+            async with self._lk:
+                return self._helper()
+        async def verb2(self):
+            async with self._lk:
+                return self._helper() + 1
+        def _helper(self):
+            return self.state
+    """
+    assert _race(clean, {"Srv.state": "_lk"}) == []
+    # now add an UNLOCKED call site: the meet over callers drops the lock
+    leaky = clean.replace(
+        "        def _helper(self):",
+        "        async def bare(self):\n"
+        "            return self._helper()\n"
+        "        def _helper(self):",
+    )
+    assert leaky != clean
+    fs = _race(leaky, {"Srv.state": "_lk"}, rule="guarded-state-unlocked")
+    assert len(fs) == 1 and "Srv._helper" in fs[0].message
+
+
+def test_holds_contract_silences_dispatched_verb():
+    """`# fhh-race: holds=` declares the lock a dynamic dispatcher takes
+    (the analyzer cannot see through getattr) — the runtime sanitizer is
+    what validates the declaration."""
+    src = """
+    import asyncio
+    class Srv:
+        def __init__(self):
+            self._lk = asyncio.Lock()
+            self.state = 0
+        # fhh-race: holds=_lk
+        async def verb(self):
+            return self.state
+    """
+    assert _race(src, {"Srv.state": "_lk"}) == []
+
+
+def test_atomic_contract_exempts_and_is_verified():
+    """`# fhh-race: atomic` exempts a suspension-free function — and the
+    analyzer VERIFIES the suspension-freedom, so adding an await to the
+    'atomic' fast path flags immediately."""
+    clean = """
+    import asyncio
+    class Srv:
+        def __init__(self):
+            self._lk = asyncio.Lock()
+            self.state = 0
+        # fhh-race: atomic (event-loop slice: append-only, no awaits)
+        async def fast(self):
+            self.state += 1
+            return self.state
+    """
+    assert _race(clean, {"Srv.state": "_lk"}) == []
+    rotted = clean.replace(
+        "            return self.state",
+        "            await asyncio.sleep(0)\n            return self.state",
+    )
+    fs = _race(rotted, {"Srv.state": "_lk"}, rule="guarded-state-unlocked")
+    assert len(fs) == 1
+    assert "suspension point" in fs[0].message and "await" in fs[0].message
+
+
+def test_module_global_guard_inline_annotation():
+    src = """
+    import threading
+    _lk = threading.Lock()
+    _hits = 0  # fhh-guard: _hits=_lk
+    def bump():
+        global _hits
+        with _lk:
+            _hits += 1
+    def bad():
+        return _hits
+    def shadowed():
+        _hits = 5  # a LOCAL, not the guarded global
+        return _hits
+    """
+    fs = _race(src, {}, rule="guarded-state-unlocked")
+    assert len(fs) == 1 and "'_hits'" in fs[0].message
+    assert "bad" in fs[0].message
+
+
+def test_nested_function_binding_does_not_shadow_module_global():
+    """A name bound only inside a NESTED def (parameter or local) lives
+    in the inner scope — it must not exempt the outer function's
+    unlocked read of the same-named guarded global (review-caught: an
+    ast.walk swept nested bindings into the outer 'locals' set)."""
+    src = """
+    import threading
+    _lk = threading.Lock()
+    _hits = 0  # fhh-guard: _hits=_lk
+    def bad_with_inner_shadow():
+        def helper(_hits):
+            return _hits  # the PARAMETER: inner scope, clean
+        return _hits  # the GLOBAL, unlocked: must flag
+    def clean_renamed_def():
+        def _hits():
+            return 0
+        return _hits()  # the nested def's NAME is a real local binding
+    """
+    fs = _race(src, {}, rule="guarded-state-unlocked")
+    assert len(fs) == 1 and "bad_with_inner_shadow" in fs[0].message
+
+
+def test_rule_scoped_to_race_modules():
+    src = """
+    import asyncio
+    class Srv:
+        def __init__(self):
+            self._lk = asyncio.Lock()
+            self.state = 0
+        async def bad(self):
+            return self.state
+    """
+    assert _race(src, {"Srv.state": "_lk"},
+                 relpath="fuzzyheavyhitters_tpu/workloads/w.py") == []
+
+
+def test_suppression_with_justification():
+    src = """
+    import asyncio
+    class Srv:
+        def __init__(self):
+            self._lk = asyncio.Lock()
+            self.state = 0
+        async def bad(self):
+            # fhh-lint: disable=guarded-state-unlocked (fixture reason)
+            return self.state
+    """
+    assert _race(src, {"Srv.state": "_lk"}) == []
+
+
+# ---------------------------------------------------------------------------
+# rule: stale-read-across-await — the snapshot/await/use atomicity break
+# ---------------------------------------------------------------------------
+
+
+def test_stale_read_across_await_detected():
+    src = """
+    import asyncio
+    class Srv:
+        def __init__(self):
+            self._lk = asyncio.Lock()
+            self.state = 0
+        async def bad(self):
+            w = self.state
+            await self.net()
+            return w
+        async def net(self):
+            pass
+    """
+    fs = _race(src, {"Srv.state": "_lk"}, rule="stale-read-across-await")
+    assert len(fs) == 1
+    assert "'w'" in fs[0].message and "'state'" in fs[0].message
+
+
+def test_lock_held_across_await_is_fresh():
+    """asyncio locks stay held through suspension: a snapshot taken and
+    used entirely under the owning lock cannot go stale."""
+    src = """
+    import asyncio
+    class Srv:
+        def __init__(self):
+            self._lk = asyncio.Lock()
+            self.state = 0
+        async def good(self):
+            async with self._lk:
+                w = self.state
+                await self.net()
+                return w
+        async def net(self):
+            pass
+    """
+    assert _race(src, {"Srv.state": "_lk"}) == []
+
+
+def test_lock_released_then_reacquired_is_stale():
+    """Releasing and re-taking the lock around an await does NOT keep a
+    pre-release snapshot fresh — the field may have moved in between."""
+    src = """
+    import asyncio
+    class Srv:
+        def __init__(self):
+            self._lk = asyncio.Lock()
+            self.state = 0
+        async def bad(self):
+            async with self._lk:
+                w = self.state
+            async with self._lk:
+                return w
+    """
+    fs = _race(src, {"Srv.state": "_lk"}, rule="stale-read-across-await")
+    assert len(fs) == 1 and "'w'" in fs[0].message
+
+
+def test_reread_after_await_is_clean():
+    src = """
+    import asyncio
+    class Srv:
+        def __init__(self):
+            self._lk = asyncio.Lock()
+            self.state = 0
+        async def good(self):
+            w = self.state
+            await self.net()
+            async with self._lk:
+                w = self.state
+                return w
+        async def net(self):
+            pass
+    """
+    assert _race(src, {"Srv.state": "_lk"},
+                 rule="stale-read-across-await") == []
+
+
+def test_every_stale_use_reports_not_just_the_first():
+    """One finding PER stale use line, not per snapshot: a suppression
+    on the first use must not silently absorb a later unsuppressed use
+    of the same stale local (review-caught on the first cut, which set
+    a per-taint reported flag)."""
+    src = """
+    import asyncio
+    class Srv:
+        def __init__(self):
+            self._lk = asyncio.Lock()
+            self.state = 0
+        async def bad(self):
+            w = self.state
+            await self.net()
+            self.log(w)
+            return w
+        def log(self, w):
+            pass
+        async def net(self):
+            pass
+    """
+    fs = _race(src, {"Srv.state": "_lk"}, rule="stale-read-across-await")
+    assert len(fs) == 2
+    suppressed_first = src.replace(
+        "self.log(w)",
+        "self.log(w)  # fhh-lint: disable=stale-read-across-await "
+        "(test: first use blessed)",
+    )
+    fs = _race(suppressed_first, {"Srv.state": "_lk"},
+               rule="stale-read-across-await")
+    assert len(fs) == 1  # the second use still fires on its own line
+
+
+def test_stale_use_in_while_condition_detected():
+    """The loop CONDITION re-evaluates after each body pass: a snapshot
+    crossed by a body await is stale when the test runs again on
+    iteration 2 (review-caught: the test expression was only visited
+    before the body)."""
+    src = """
+    import asyncio
+    class Srv:
+        def __init__(self):
+            self._lk = asyncio.Lock()
+            self.state = 0
+        async def bad(self):
+            async with self._lk:
+                w = self.state
+            while w == self.state:
+                await self.net()
+        async def net(self):
+            pass
+    """
+    fs = _race(src, {"Srv.state": "_lk"}, rule="stale-read-across-await")
+    assert len(fs) == 1 and "'w'" in fs[0].message
+
+
+_SEAL_SHAPE = """
+import asyncio
+class WIngest:
+    def __init__(self):
+        self._submit_lock = asyncio.Lock()
+        self.window = 0
+    async def seal(self):
+        {read_outside}async with self._submit_lock:
+            {read_inside}await self.call_both({{"window": w}})
+            {advance_inside}
+        {advance_outside}
+    async def call_both(self, req):
+        pass
+"""
+
+
+def test_pr7_stale_window_id_shape_fires_and_fixed_form_is_silent():
+    """The exact bug class every review round hand-caught: the window id
+    snapshotted BEFORE the lock, used to name the window after the
+    acquire suspension (and the counter advanced from the stale value
+    after release).  The fixed form — read and advance under one lock
+    hold — is silent under both rules."""
+    buggy = _SEAL_SHAPE.format(
+        read_outside="w = self.window\n        ",
+        read_inside="",
+        advance_inside="pass",
+        advance_outside="self.window = w + 1",
+    )
+    fs = _race(textwrap.dedent(buggy), {"WIngest.window": "_submit_lock"})
+    assert "stale-read-across-await" in _names(fs)
+    assert any("'window'" in f.message and "PR-7" in f.message for f in fs)
+    fixed = _SEAL_SHAPE.format(
+        read_outside="",
+        read_inside="w = self.window\n            ",
+        advance_inside="self.window = w + 1",
+        advance_outside="",
+    )
+    assert _race(textwrap.dedent(fixed),
+                 {"WIngest.window": "_submit_lock"}) == []
+
+
+# ---------------------------------------------------------------------------
+# guard-map plumbing: pyproject table, LintConfig, runtime twins
+# ---------------------------------------------------------------------------
+
+
+def test_guards_table_loads_from_pyproject(tmp_path):
+    (tmp_path / "pyproject.toml").write_text(
+        "[tool.fhh-lint]\n"
+        'race_modules = ["pkg"]\n'
+        "[tool.fhh-lint.guards]\n"
+        '"A.x" = "_lk"\n'
+        '"A.y" = "_other"\n'
+    )
+    cfg = load_config(str(tmp_path))
+    # the table REPLACES the shipped defaults (it must be able to retire
+    # a binding), and dotted quoted keys parse
+    assert cfg.guards == {"A.x": "_lk", "A.y": "_other"}
+    assert cfg.race_modules == ("pkg",)
+
+
+def test_guard_map_drift_pyproject_vs_runtime_twins():
+    """One guard map, three copies: pyproject [tool.fhh-lint.guards]
+    (operative), LintConfig defaults (covered by test_analysis's drift
+    test), and the runtime twin tables the sanitizer arms.  This pins
+    pyproject == runtime twins, so an attribute guarded statically is
+    exactly the set asserted dynamically."""
+    cfg = load_config(REPO)
+    want = {
+        f"CollectorServer.{a}": lk for a, lk in rpc._SERVER_GUARDS.items()
+    }
+    want.update({
+        f"WindowedIngest.{a}": lk
+        for a, lk in leader_rpc._INGEST_GUARDS.items()
+    })
+    assert cfg.guards == want
+
+
+def test_repo_race_self_analysis_at_zero():
+    """Tier-1 gate: the interprocedural pass over the declared race scope
+    reports ZERO findings — every verb carries its contract, every
+    deliberately-unlocked site its verified atomic annotation or written
+    suppression, and both real leader-side bugs are fixed."""
+    cfg = load_config(REPO)
+    race = [RULES_BY_NAME[r] for r in RACE_RULE_NAMES]
+    findings, errors = lint_paths(list(cfg.race_modules), cfg, REPO,
+                                  rules=race)
+    assert errors == []
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# baseline hygiene: a rule rename must not read as a silent burn-down
+# ---------------------------------------------------------------------------
+
+
+def test_removed_rules_names_unknown_ids():
+    counts = {
+        "bare-print": {"a.py": 1},
+        "old-rule": {"a.py": 2, "b.py": 1},
+        "ghost-rule": {},
+    }
+    assert removed_rules(counts, RULES_BY_NAME) == [("old-rule", 2, 3)]
+
+
+def test_update_baseline_reports_renamed_rule_ids(tmp_path):
+    """--update-baseline names every baseline entry whose rule id no
+    longer exists (a rename used to shrink the file silently) and drops
+    them from the rewrite."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("def f(x):\n    print(x)\n")
+    base = tmp_path / "lint_baseline.json"
+    base.write_text(json.dumps({
+        "schema": "fhh-lint-baseline/1",
+        "counts": {
+            "renamed-away-rule": {"pkg/mod.py": 2, "pkg/other.py": 1},
+            "bare-print": {"pkg/mod.py": 1},
+        },
+    }))
+    (tmp_path / "pyproject.toml").write_text(
+        "[tool.fhh-lint]\nprint_scope = [\"pkg\"]\n"
+        "baseline = \"lint_baseline.json\"\n"
+    )
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "fuzzyheavyhitters_tpu.analysis",
+         "pkg", "--update-baseline", "--root", str(tmp_path)],
+        cwd=str(tmp_path), capture_output=True, text=True, env=env,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "renamed-away-rule" in proc.stderr
+    assert "3 finding(s) across 2 file(s)" in proc.stderr
+    counts = load_baseline(str(base))
+    assert counts == {"bare-print": {"pkg/mod.py": 1}}, counts
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer: GuardedState semantics
+# ---------------------------------------------------------------------------
+
+
+class _Obj:
+    def __init__(self):
+        self._lk = asyncio.Lock()
+        self.state = 0
+
+
+def test_guarded_state_asserts_and_windows():
+    obj = _Obj()
+    assert guards.install(obj, {"state": "_lk"}, force=True)
+    assert type(obj).__name__ == "Guarded_Obj"
+
+    async def flow():
+        with pytest.raises(guards.GuardViolation):
+            _ = obj.state
+        with pytest.raises(guards.GuardViolation):
+            obj.state = 1
+        async with obj._lk:
+            obj.state = 2
+            assert obj.state == 2
+        with guards.unguarded("test window (mirrors a written suppression)"):
+            assert obj.state == 2
+
+    asyncio.run(flow())
+
+
+def test_guarded_state_cross_task_ownership():
+    """lock.locked() alone is not ownership: an access while ANOTHER task
+    holds the lock is exactly the race the lock exists to prevent."""
+    obj = _Obj()
+    assert guards.install(obj, {"state": "_lk"}, force=True)
+
+    async def flow():
+        entered = asyncio.Event()
+
+        async def holder():
+            async with obj._lk:
+                entered.set()
+                await asyncio.sleep(0.05)
+
+        h = asyncio.create_task(holder())
+        await entered.wait()
+        with pytest.raises(guards.GuardViolation):
+            _ = obj.state
+        await h
+
+    asyncio.run(flow())
+
+
+def test_sanitizer_off_by_default_no_overhead(monkeypatch):
+    monkeypatch.delenv("FHH_DEBUG_GUARDS", raising=False)
+    obj = _Obj()
+    assert not guards.install(obj, {"state": "_lk"})
+    # the class is untouched: attribute access stays a plain dict lookup,
+    # no descriptor hop, no lock wrapping
+    assert type(obj) is _Obj
+    assert not hasattr(obj._lk, "_fhh_tracked")
+    obj.state = 3
+    assert obj.state == 3
+
+
+def test_env_var_arms_install(monkeypatch):
+    monkeypatch.setenv("FHH_DEBUG_GUARDS", "1")
+    obj = _Obj()
+    assert guards.enabled() and guards.install(obj, {"state": "_lk"})
+    assert type(obj) is not _Obj
+
+
+def test_unguarded_requires_reason():
+    with pytest.raises(ValueError):
+        with guards.unguarded(""):
+            pass
+    with pytest.raises(ValueError):
+        with guards.unguarded("   "):
+            pass
+
+
+def test_sanitizer_raises_on_unlocked_server_access():
+    """THE acceptance check: a sanitizer-armed CollectorServer refuses a
+    deliberately unguarded access — a verb invoked directly, bypassing
+    _dispatch's lock — and accepts the same verb with the lock held."""
+    cfg = _cfg(debug_guards=True)
+    s = rpc.CollectorServer(0, cfg)
+
+    async def flow():
+        with pytest.raises(guards.GuardViolation):
+            await s.reset({})  # bypasses _dispatch: lock not held
+        async with s._verb_lock:
+            assert await s.reset({})  # same verb, owned lock: clean
+
+    asyncio.run(flow())
+
+
+# ---------------------------------------------------------------------------
+# the seal-window regression fhh-race caught (leader_rpc.py)
+# ---------------------------------------------------------------------------
+
+
+class _StubClient:
+    session_id = "sess"
+    boot_id = "boot"
+
+
+class _StubLead:
+    """Minimal RpcLeader surface for WindowedIngest: seal verbs answer
+    canned identical stats after a real suspension (forcing the racing
+    interleave the old pre-lock window-id read was vulnerable to)."""
+
+    def __init__(self):
+        self.cfg = SimpleNamespace(debug_guards=False)
+        self.c0, self.c1 = _StubClient(), _StubClient()
+        self._boot_ids = {}
+        self.sealed_reqs = []
+
+    async def _both(self, verb, req):
+        assert verb == "window_seal"
+        self.sealed_reqs.append(dict(req))
+        await asyncio.sleep(0.01)  # a real suspension point
+        r = {"keys": 0, "subs": 0, "shed_keys": 0, "rejected": 0}
+        return r, dict(r)
+
+
+def test_concurrent_seals_advance_distinct_windows():
+    """Regression for the fhh-race finding: two concurrent seal_window()
+    calls must seal windows 0 and 1 and leave the counter at 2.  The old
+    form read `self.window` BEFORE taking the submit lock and advanced
+    it after release — the loser re-sealed window 0 and ROLLED THE
+    COUNTER BACK to 1, wedging later submissions into a sealed window."""
+    lead = _StubLead()
+    wi = WindowedIngest(lead, checkpoint=False)
+
+    async def flow():
+        await asyncio.gather(wi.seal_window(), wi.seal_window())
+
+    asyncio.run(flow())
+    assert wi.window == 2
+    assert sorted(r["window"] for r in lead.sealed_reqs) == [0, 1]
+    assert set(wi._sealed) == {0, 1}
+
+
+# ---------------------------------------------------------------------------
+# e2e: full socket crawl, sanitizer armed, bit-identical to unarmed
+# ---------------------------------------------------------------------------
+
+
+def _cfg(**kw):
+    defaults = dict(
+        data_len=6,
+        n_dims=1,
+        ball_size=2,
+        addkey_batch_size=8,
+        num_sites=4,
+        threshold=0.1,
+        zipf_exponent=1.03,
+        server0=f"127.0.0.1:{BASE_PORT}",
+        server1=f"127.0.0.1:{BASE_PORT + 10}",
+        distribution="zipf",
+        f_max=128,
+    )
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+async def _socket_crawl(cfg, keys0, keys1, nreqs, port0, port1):
+    s0 = rpc.CollectorServer(0, cfg)
+    s1 = rpc.CollectorServer(1, cfg)
+    peer = port1 + 1
+    t1 = asyncio.create_task(s1.start("127.0.0.1", port1, "127.0.0.1", peer))
+    await asyncio.sleep(0.05)
+    t0 = asyncio.create_task(s0.start("127.0.0.1", port0, "127.0.0.1", peer))
+    c0 = await rpc.CollectorClient.connect("127.0.0.1", port0)
+    c1 = await rpc.CollectorClient.connect("127.0.0.1", port1)
+    await asyncio.gather(t0, t1)
+    lead = RpcLeader(cfg, c0, c1)
+    await asyncio.gather(c0.call("reset"), c1.call("reset"))
+    await lead.upload_keys(keys0, keys1)
+    res = await lead.run(nreqs)
+    await asyncio.gather(c0.aclose(), c1.aclose())
+    await asyncio.gather(s0.aclose(), s1.aclose())
+    return res
+
+
+def test_e2e_socket_crawl_green_with_sanitizer(rng):
+    """A full trusted crawl through the production verb path with the
+    sanitizer armed (Config.debug_guards): every guarded access on both
+    servers asserts its owning lock, and the results are bit-identical
+    to the unarmed run — the sanitizer observes, never perturbs."""
+    # (L, d, n, f_max) match test_rpc/test_protocol's d=1 scenarios so
+    # the crawl kernels compile once across the suites
+    L, n = 6, 40
+    pts = np.concatenate(
+        [np.full(32, 20), rng.integers(0, 1 << L, size=8)]
+    )[:, None]
+    pts_bits = np.array(
+        [[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts]
+    )
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng)
+    plain = asyncio.run(_socket_crawl(
+        _cfg(), k0, k1, n, BASE_PORT, BASE_PORT + 10
+    ))
+    armed = asyncio.run(_socket_crawl(
+        _cfg(debug_guards=True), k0, k1, n, BASE_PORT + 2, BASE_PORT + 12
+    ))
+    np.testing.assert_array_equal(plain.counts, armed.counts)
+    np.testing.assert_array_equal(plain.paths, armed.paths)

@@ -306,17 +306,36 @@ def test_compile_cache_enable(tmp_path, monkeypatch):
 
     from fuzzyheavyhitters_tpu.utils import compile_cache
 
-    monkeypatch.setattr(compile_cache, "_enabled", None)
-    monkeypatch.delenv("FHH_COMPILE_CACHE", raising=False)
-    assert compile_cache.enable() is None
+    # snapshot every jax.config knob enable() mutates and restore them
+    # after: this test used to leave the PROCESS-WIDE compilation cache
+    # pointed at its deleted tmp_path, so every module that ran after
+    # test_pipeline recompiled cold — the compile-bound back half of the
+    # suite (secure_kernels, sketch) inflated 3-5x and blew the tier-1
+    # wall-clock budget
+    restore = {
+        knob: getattr(jax.config, knob)
+        for knob in (
+            "jax_compilation_cache_dir",
+            "jax_persistent_cache_min_compile_time_secs",
+            "jax_persistent_cache_min_entry_size_bytes",
+        )
+        if hasattr(jax.config, knob)
+    }
+    try:
+        monkeypatch.setattr(compile_cache, "_enabled", None)
+        monkeypatch.delenv("FHH_COMPILE_CACHE", raising=False)
+        assert compile_cache.enable() is None
 
-    cache = tmp_path / "xla-cache"
-    monkeypatch.setenv("FHH_COMPILE_CACHE", str(cache))
-    assert compile_cache.enable() == str(cache)
-    assert cache.is_dir()
-    assert jax.config.jax_compilation_cache_dir == str(cache)
-    # idempotent: a second call (different arg) returns the winner
-    assert compile_cache.enable(str(tmp_path / "other")) == str(cache)
+        cache = tmp_path / "xla-cache"
+        monkeypatch.setenv("FHH_COMPILE_CACHE", str(cache))
+        assert compile_cache.enable() == str(cache)
+        assert cache.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(cache)
+        # idempotent: a second call (different arg) returns the winner
+        assert compile_cache.enable(str(tmp_path / "other")) == str(cache)
+    finally:
+        for knob, val in restore.items():
+            jax.config.update(knob, val)
 
 
 def test_bench_budget_and_compact_line(monkeypatch):
