@@ -803,7 +803,7 @@ def bench_secure(n=1024, L=12, port=39831, shard_nodes=4, pipeline_depth=4):
 
 
 def bench_multichip(n=1024, L=12, port=40231, shards=(1, 2, 4, 8),
-                    f_max=64):
+                    f_max=64, kernel_shards=(1, 2, 4, 8)):
     """Multi-chip collector servers: secure clients/sec as each server's
     client axis shards over 1/2/4/8 LOCAL data devices
     (parallel/server_mesh.py — ``Config.server_data_devices``).  Every
@@ -815,7 +815,17 @@ def bench_multichip(n=1024, L=12, port=40231, shards=(1, 2, 4, 8),
     visible device count (or not dividing the client batch) are
     reported as skipped, not silently dropped — on a CPU host run under
     ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (the smoke
-    path) all four legs run."""
+    path) all four legs run.
+
+    KERNEL-SHARDED legs (PR 10): at the top feasible data-shard count, a
+    second sweep varies ``Config.secure_kernel_shards`` over
+    ``kernel_shards`` — 1 pins the gather-to-one-device kernel stage
+    (the pre-PR-10 layout), higher caps run the row-sharded IKNP +
+    equality kernels (parallel/kernel_shard.py).  Each leg is
+    bit-identity-gated like the data legs;
+    ``whole_level_speedup_vs_gathered`` is the top kernel leg's rate
+    over the gathered leg's, and ``kernel_gather_seconds`` (should read
+    ~0 on the sharded legs' deep levels) rides the compact line."""
     import asyncio
     import jax
 
@@ -831,19 +841,19 @@ def bench_multichip(n=1024, L=12, port=40231, shards=(1, 2, 4, 8),
     )
     k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng, engine=_keygen_engine())
 
-    def leg_cfg(p, k):
+    def leg_cfg(p, k, ks=0):
         return Config(
             data_len=L, n_dims=1, ball_size=2, addkey_batch_size=1024,
             num_sites=8, threshold=0.05, zipf_exponent=1.03,
             server0=f"127.0.0.1:{p}", server1=f"127.0.0.1:{p + 10}",
             distribution="zipf", f_max=f_max, secure_exchange=True,
-            server_data_devices=k,
+            server_data_devices=k, secure_kernel_shards=ks,
         )
 
     n_devices = len(jax.devices())
 
-    async def one_leg(k, p):
-        cfg = leg_cfg(p, k)
+    async def one_leg(k, p, ks=0):
+        cfg = leg_cfg(p, k, ks)
         lead, c0, c1, s0, s1 = await _bring_up_pair(cfg, p)
         try:
             await lead.upload_keys(k0, k1)
@@ -859,6 +869,10 @@ def bench_multichip(n=1024, L=12, port=40231, shards=(1, 2, 4, 8),
                 + s1.obs.timer_seconds("ici_reduce")
             )
             st = await c0.call("status")
+            # kernel_shards_max (the deepest sharding the crawl
+            # engaged) comes from the status verb like every other
+            # mesh-health number — the wire interface, not a reach into
+            # the in-process registry
             return res, dt, ici, st.get("mesh")
         finally:
             for c in (c0, c1):
@@ -877,7 +891,10 @@ def bench_multichip(n=1024, L=12, port=40231, shards=(1, 2, 4, 8),
         if server_mesh._largest_divisor_leq(n, k) != k:
             skipped[str(k)] = "batch"
             continue
-        res, dt, ici, mesh_st = asyncio.run(one_leg(k, port + 40 * i))
+        # the data-shard sweep pins the GATHERED kernel stage (kernel
+        # cap 1) so its legs measure exactly what PR 8 measured; the
+        # kernel sweep below owns the sharded-kernel comparison
+        res, dt, ici, mesh_st = asyncio.run(one_leg(k, port + 40 * i, ks=1))
         rates[str(k)] = round(n / dt, 1)
         if base_res is None:
             base_res = res
@@ -888,16 +905,60 @@ def bench_multichip(n=1024, L=12, port=40231, shards=(1, 2, 4, 8),
             assert np.array_equal(base_res.paths, res.paths)
         if k >= top[0]:
             top = (k, ici, mesh_st)
+    # kernel-sharded sweep at the top feasible data-shard count: vary
+    # the secure_kernel_shards cap, 1 = the gathered baseline
+    kernel_rates: dict = {}
+    kernel_skipped: dict = {}
+    k_top_status = None
+    k_engaged = None
+    kg_seconds = None
+    data_top = top[0]
+    for j, s in enumerate(kernel_shards):
+        if base_res is None or data_top < 2:
+            kernel_skipped[str(s)] = "devices"
+            continue
+        if s > data_top:
+            kernel_skipped[str(s)] = "devices"
+            continue
+        if s == 1 and str(data_top) in rates:
+            # the gathered baseline IS the data sweep's top leg (the
+            # data legs pin kernel cap 1) — reuse its rate instead of
+            # re-running an identical warmed server pair
+            kernel_rates["1"] = rates[str(data_top)]
+            continue
+        res, dt, ici, mesh_st = asyncio.run(
+            one_leg(data_top, port + 2000 + 40 * j, ks=s)
+        )
+        assert np.array_equal(base_res.counts, res.counts)
+        assert np.array_equal(base_res.paths, res.paths)
+        kernel_rates[str(s)] = round(n / dt, 1)
+        if mesh_st is not None:
+            k_top_status = mesh_st
+            if s > 1:
+                k_engaged = mesh_st.get("kernel_shards_max")
+                kg_seconds = mesh_st.get("kernel_gather_seconds")
+    speedup = None
+    if len(kernel_rates) > 1 and kernel_rates.get("1"):
+        best = max(
+            v for s, v in kernel_rates.items() if s != "1"
+        )
+        speedup = round(best / kernel_rates["1"], 3)
     return {
         "bit_identical": base_res is not None and len(rates) > 1,
         "data_shards": top[0],
         "ici_reduce_seconds": round(top[1], 3),
         "secure_clients_per_sec": rates,
         "skipped_shards": skipped,
+        # kernel-sharded legs (bit-identity-gated like the data legs)
+        "kernel_shards": k_engaged,
+        "kernel_clients_per_sec": kernel_rates,
+        "kernel_gather_seconds": kg_seconds,
+        "whole_level_speedup_vs_gathered": speedup,
+        "kernel_skipped": kernel_skipped,
         "n_clients": n,
         "data_len": L,
         "n_devices": n_devices,
-        "mesh_status": top[2],
+        "mesh_status": k_top_status or top[2],
     }
 
 
@@ -1659,7 +1720,8 @@ _COMPACT_KEYS = {
     ),
     "multichip": (
         "secure_clients_per_sec", "data_shards", "ici_reduce_seconds",
-        "bit_identical",
+        "bit_identical", "kernel_shards", "kernel_clients_per_sec",
+        "kernel_gather_seconds", "whole_level_speedup_vs_gathered",
     ),
 }
 
@@ -1745,16 +1807,20 @@ def main():
     multichip = section(
         "multichip",
         "import json, bench;print(json.dumps(bench.bench_multichip()))",
-        # four warmed legs (1/2/4/8 data shards), each its own server
-        # pair with its own sharded program ladder
-        timeout_s=720,
+        # warmed legs: 1/2/4/8 data shards plus the kernel-sharded sweep
+        # at the top count, each its own server pair with its own
+        # sharded program ladder
+        timeout_s=900,
         # f_max=32 trims one warmup-ladder rung per leg per field
         # (the zipf smoke frontier peaks at 28 survivors) — the smoke
-        # budget must leave room for the ingest section after this
+        # budget must leave room for the ingest section after this;
+        # n=512 puts every bucket-16 rung at 16384 tests = 2 planar
+        # blocks, so the kernel-sharded legs engage (kernel_shards=2)
+        # without depending on the borderline bucket-32 survivors
         smoke_code=(
             "import json, bench;"
-            "print(json.dumps(bench.bench_multichip(n=64, L=6,"
-            " shards=(1, 2, 4), f_max=32)))"
+            "print(json.dumps(bench.bench_multichip(n=512, L=5,"
+            " shards=(1, 2, 4), f_max=32, kernel_shards=(1, 2))))"
         ),
     )
     secure_device = section(

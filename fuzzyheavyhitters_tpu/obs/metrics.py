@@ -208,6 +208,29 @@ class Registry:
                 return 0
             return ent["total"] if level is None else ent["levels"].get(level, 0)
 
+    def gauge_value(self, name: str, level: int | None = None):
+        """Last-written gauge value (None when never set) — the status
+        verb's read side of per-level layout gauges like
+        ``kernel_shards``."""
+        with self._lock:
+            ent = self._gauges.get(name)
+            if ent is None:
+                return None
+            return ent["last"] if level is None else ent["levels"].get(level)
+
+    def gauge_max(self, name: str):
+        """Maximum over every per-level write of gauge ``name`` (None
+        when never set) — how the status verb reports the DEEPEST
+        layout a crawl engaged (the last-written value alone hides a
+        mid-crawl peak, e.g. a leaf level that degraded to fewer kernel
+        shards than the widest inner level)."""
+        with self._lock:
+            ent = self._gauges.get(name)
+            if ent is None:
+                return None
+            vals = list(ent["levels"].values()) + [ent["last"]]
+            return max(vals)
+
     def timer_seconds(self, name: str, level: int | None = None) -> float:
         with self._lock:
             ent = self._timers.get(name)

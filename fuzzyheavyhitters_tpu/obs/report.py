@@ -50,7 +50,12 @@ Well-known metric names (what populates them):
 - gauge ``data_shards`` + phase ``ici_reduce`` + counters
   ``mesh_reshards`` / ``mesh_faults`` (multi-chip servers,
   parallel/server_mesh.py: client-axis shard count, the pre-wire ICI
-  psum's fetch-synced seconds, and device-loss recovery events) — rolled
+  psum's fetch-synced seconds, and device-loss recovery events), plus
+  gauge ``kernel_shards`` + phase ``kernel_gather`` / counter
+  ``kernel_gathers`` (the row-sharded secure kernel stage,
+  parallel/kernel_shard.py: the level's active kernel shard count — 1 =
+  the degraded gather-to-one-device path — and that gather's dispatch
+  seconds, ~0 whenever the sharded stage carries the crawl) — rolled
   up into a top-level ``mesh`` section whenever a multi-chip crawl ran.
 - counters ``ingest_admitted`` / ``ingest_shed`` / ``ingest_rejected`` /
   ``ingest_windows`` + phases ``ingest`` / ``window_crawl`` (the
@@ -242,6 +247,8 @@ def _secure_kernel_summary(registries: dict) -> dict | None:
     totals = dict.fromkeys(names, 0.0)
     by_level: dict = {}
     paths = {"ot2s": 0, "gc": 0}
+    kshards = None
+    kgather = 0.0
     seen = False
     for snap in registries.values():
         phases = snap.get("phases", {})
@@ -259,6 +266,14 @@ def _secure_kernel_summary(registries: dict) -> dict | None:
             if c is not None:
                 seen = True
                 paths[p] += c.get("total", 0)
+        g = snap.get("gauges", {}).get("kernel_shards")
+        if g is not None:
+            kshards = g.get("last") if kshards is None else max(
+                kshards, g.get("last")
+            )
+        t = phases.get("kernel_gather")
+        if t is not None:
+            kgather += t.get("seconds", 0.0)
     if not seen:
         return None
     if paths["ot2s"] and paths["gc"]:
@@ -271,6 +286,12 @@ def _secure_kernel_summary(registries: dict) -> dict | None:
         "ot_path": ot_path,
         "levels_ot2s": paths["ot2s"],
         "levels_gc": paths["gc"],
+        # kernel-stage layout (multi-chip servers only; None/0.0 on a
+        # single-device crawl — see the mesh section for the per-level
+        # breakdown): the phase seconds above are the SHARDED kernels'
+        # whenever kernel_shards > 1
+        "kernel_shards": kshards,
+        "kernel_gather_seconds": round(kgather, 6),
         **{f"{n}_seconds": round(totals[n], 6) for n in names},
         "by_level": {
             lvl: {n: round(v[n], 6) for n in names}
@@ -334,9 +355,11 @@ def _mesh_summary(registries: dict) -> dict | None:
     never emit these metrics."""
     shards_last = None
     shards_by: dict = {}
-    ici_total = 0.0
+    kshards_last = None
+    kshards_by: dict = {}
+    ici_total = kgather_total = 0.0
     ici_by: dict = {}
-    reshards = faults = 0
+    reshards = faults = kgathers = 0
     seen = False
     for snap in registries.values():
         g = snap.get("gauges", {}).get("data_shards")
@@ -344,12 +367,26 @@ def _mesh_summary(registries: dict) -> dict | None:
             seen = True
             shards_last = g.get("last")
             shards_by.update(g.get("by_level", {}))
+        g = snap.get("gauges", {}).get("kernel_shards")
+        if g is not None:
+            seen = True
+            kshards_last = g.get("last")
+            for lvl, v in g.get("by_level", {}).items():
+                kshards_by[lvl] = max(kshards_by.get(lvl, 0), v)
         t = snap.get("phases", {}).get("ici_reduce")
         if t is not None:
             seen = True
             ici_total += t.get("seconds", 0.0)
             for lvl, s in t.get("by_level", {}).items():
                 ici_by[lvl] = ici_by.get(lvl, 0.0) + s
+        t = snap.get("phases", {}).get("kernel_gather")
+        if t is not None:
+            seen = True
+            kgather_total += t.get("seconds", 0.0)
+        c = snap.get("counters", {}).get("kernel_gathers")
+        if c is not None:
+            seen = True
+            kgathers += c.get("total", 0)
         for name in ("mesh_reshards", "mesh_faults"):
             c = snap.get("counters", {}).get(name)
             if c is None:
@@ -365,12 +402,27 @@ def _mesh_summary(registries: dict) -> dict | None:
     return {
         "data_shards": shards_last,
         "ici_reduce_seconds": round(ici_total, 6),
+        # row-sharded secure kernel stage (parallel/kernel_shard.py):
+        # the active kernel-shard count (1 = the degraded gather path).
+        # kernel_gathers counts exactly the crawl levels that gathered
+        # the packed share bits onto one device — the LAYOUT detector
+        # (0 on a fully sharded crawl); kernel_gather_seconds is those
+        # gathers' dispatch time (the transfer completes lazily under
+        # the level's later fetch), a supplement to the counter
+        "kernel_shards": kshards_last,
+        "kernel_gathers": kgathers,
+        "kernel_gather_seconds": round(kgather_total, 6),
         "reshards": reshards,
         "faults": faults,
         "by_level": {
             lvl: {
                 "data_shards": shards_by.get(lvl),
                 "ici_reduce_seconds": round(ici_by.get(lvl, 0.0), 6),
+                **(
+                    {"kernel_shards": kshards_by[lvl]}
+                    if lvl in kshards_by
+                    else {}
+                ),
             }
             for lvl in levels
         },

@@ -196,6 +196,51 @@ def _carve_label_words(seed, B: int, S: int, n_label_sets: int, with_r: bool):
     return R, sets, mask
 
 
+def _carve_label_words_shard(seed, B: int, S: int, t0, bloc: int):
+    """Tests [t0, t0 + bloc) of the ``with_r=False`` single-set draw of
+    :func:`_carve_label_words` — the row-sharded kernel stage's slice of
+    the garbler's label/mask randomness (parallel/kernel_shard.py).
+
+    The stream is CTR-mode (prg.stream_blocks seeks by block), so the
+    slice is computed without materializing the full draw: the label
+    region of shard i starts at stream word ``t0*S*4`` and the mask-bit
+    region at word ``B*S*4 + t0//32`` — ``t0`` (which may be TRACED:
+    lax.axis_index × a static shard extent) must be a multiple of 512
+    tests so both regions start block-aligned after the static
+    intra-block offset of the mask region is folded in.  Tests at or past
+    ``B`` (the planar pad region) come back ZERO, exactly matching the
+    single-device twin's ``_pad_tests`` padding — byte-identity of the
+    packed wire holds shard-for-shard.
+
+    Returns (X0 uint32[bloc, S, 4], mask bool[bloc]).
+    """
+    seed = jnp.asarray(seed, jnp.uint32)
+    # int64 (the package enables x64): the label-region word seek below
+    # multiplies t0 by S*4 — int32 wraps at ~134M padded tests at S=4,
+    # inside the 1M-client flagship scale
+    t0 = jnp.asarray(t0, jnp.int64)
+    live = t0 + jnp.arange(bloc) < B  # global pad tests carve to zero
+    # label region: words [t0*S*4, (t0+bloc)*S*4) — t0*S*4 ≡ 0 (mod 16)
+    nb = bloc * S * 4 // 16
+    lab = prg.stream_blocks(seed, nb, t0 * (S * 4) // 16)
+    X0 = lab.reshape(bloc, S, 4)
+    X0 = jnp.where(live[:, None, None], X0, jnp.uint32(0))
+    # mask region: starts at global word M0 = B*S*4 (static, any residue
+    # mod 16); the shard needs words [M0 + t0//32, M0 + t0//32 + bloc//32)
+    # — t0//32 is a multiple of 16, so the intra-block offset is the
+    # STATIC M0 % 16 and the blocks seek from (M0 - M0%16)//16 + t0//512
+    M0 = B * S * 4
+    intra = M0 % 16
+    cw = (bloc + 31) // 32
+    nb2 = -(-(intra + cw) // 16)
+    mwords = prg.stream_blocks(
+        seed, nb2, (M0 - intra) // 16 + t0 // 512
+    ).reshape(nb2 * 16)[intra : intra + cw]
+    i = jnp.arange(bloc)
+    mask = ((mwords[i // 32] >> (i % 32).astype(jnp.uint32)) & 1).astype(bool)
+    return X0, mask & live
+
+
 def _garble_core(R, X0, Y0, mask, x_bits):
     """Shared garbling core: labels + offset in, (batch, output zero-labels)
     out — ``out0`` is what payload delivery hashes (see
@@ -372,11 +417,41 @@ def _pad_tests(a, bp: int):
     )
 
 
+def _garble_packed_planes_xla(R, Y0, X0, mask, x_bits, m_v0, m_v1,
+                              n_words: int, idx_offset):
+    """The packed-garble math AFTER label carving: every input already at
+    the full planar extent (``x_bits.shape[0]`` a multiple of the planar
+    block, pad slots zero).  Shared by the single-device twin below
+    (which carves then pads) and the row-sharded kernel stage
+    (parallel/kernel_shard.py — each shard feeds its
+    :func:`_carve_label_words_shard` slice and a TRACED ``idx_offset``),
+    so the planar wire bytes come from exactly one defining form.
+    Returns the raveled planar buffer (tables | gb_labels | decode |
+    cts planes)."""
+    from . import gc_pallas
+    from .otext import ot_hash
+
+    bp = x_bits.shape[0]
+    batch, out0 = _garble_core(R, X0, Y0, mask, x_bits)
+    h0 = ot_hash(out0, n_words, idx_offset)
+    h1 = ot_hash(out0 ^ R, n_words, idx_offset)
+    c_v0 = jnp.asarray(m_v0, jnp.uint32) ^ h0
+    c_v1 = jnp.asarray(m_v1, jnp.uint32) ^ h1
+    p = _lsb(out0)[:, None]
+    cts = jnp.stack([jnp.where(p, c_v1, c_v0), jnp.where(p, c_v0, c_v1)])
+    parts = [
+        gc_pallas._planarize(batch.tables, bp, bp),
+        gc_pallas._planarize(batch.gb_labels, bp, bp),
+        gc_pallas._planarize(jnp.asarray(batch.decode, jnp.uint32), bp, bp),
+        gc_pallas._planarize(jnp.transpose(cts, (1, 0, 2)), bp, bp),
+    ]
+    return jnp.concatenate([jnp.ravel(p_) for p_ in parts])
+
+
 @partial(jax.jit, static_argnames=("n_words",))
 def _garble_equality_payload_packed_xla(R, Y0, seed, x_bits, m_v0, m_v1,
                                         n_words: int, idx_offset):
     from . import gc_pallas
-    from .otext import ot_hash
 
     x_bits = jnp.asarray(x_bits, bool)
     B, S = x_bits.shape
@@ -386,24 +461,14 @@ def _garble_equality_payload_packed_xla(R, Y0, seed, x_bits, m_v0, m_v1,
     # matching the kernel's zero-padded planar inputs bit for bit
     _, (X0,), mask = _carve_label_words(seed, B, S, 1, with_r=False)
     R = jnp.asarray(R, jnp.uint32)
-    batch, out0 = _garble_core(
-        R, _pad_tests(X0, bp),
-        _pad_tests(jnp.asarray(Y0, jnp.uint32), bp),
+    msg = _garble_packed_planes_xla(
+        R, _pad_tests(jnp.asarray(Y0, jnp.uint32), bp), _pad_tests(X0, bp),
         _pad_tests(mask, bp), _pad_tests(x_bits, bp),
+        _pad_tests(jnp.asarray(m_v0, jnp.uint32), bp),
+        _pad_tests(jnp.asarray(m_v1, jnp.uint32), bp),
+        n_words, idx_offset,
     )
-    h0 = ot_hash(out0, n_words, idx_offset)
-    h1 = ot_hash(out0 ^ R, n_words, idx_offset)
-    c_v0 = _pad_tests(jnp.asarray(m_v0, jnp.uint32), bp) ^ h0
-    c_v1 = _pad_tests(jnp.asarray(m_v1, jnp.uint32), bp) ^ h1
-    p = _lsb(out0)[:, None]
-    cts = jnp.stack([jnp.where(p, c_v1, c_v0), jnp.where(p, c_v0, c_v1)])
-    parts = [
-        gc_pallas._planarize(batch.tables, bp, bp),
-        gc_pallas._planarize(batch.gb_labels, bp, bp),
-        gc_pallas._planarize(jnp.asarray(batch.decode, jnp.uint32), bp, bp),
-        gc_pallas._planarize(jnp.transpose(cts, (1, 0, 2)), bp, bp),
-    ]
-    return jnp.concatenate([jnp.ravel(p_) for p_ in parts]), mask
+    return msg, mask
 
 
 @partial(jax.jit, static_argnames=("S", "n_words"))

@@ -470,3 +470,32 @@ def eval_equality_payload_packed(msg, ev_labels, n_words: int, idx_offset,
     if S < 2:
         raise ValueError("gc_pallas requires S >= 2 wire strings")
     return _eval_packed(msg, ev_labels, idx_offset, S, n_words, interpret)
+
+
+# -- row-sharded (shard_map) entries ----------------------------------------
+#
+# Under the multi-chip kernel stage (parallel/kernel_shard.py) each mesh
+# shard garbles/evaluates its own whole-planar-block slice of the level:
+# inputs arrive ALREADY sliced and zero-padded (labels + mask from
+# gc._carve_label_words_shard, Y0 from the row-sharded extension), and
+# ``idx_offset`` is the session base PLUS the shard's global test offset
+# — a TRACED value (lax.axis_index), which the kernels already accept
+# (it rides SMEM).  Because each shard's extent is a whole number of
+# R_BLK*GROUP blocks, the pallas grid and the planar layout need no
+# per-shard padding, and the per-shard buffers concatenate along the row
+# axis into the byte-identical single-device wire.
+
+
+def garble_packed_planes(R, Y0, X0, mask, x_bits, m_v0, m_v1,
+                         n_words: int, idx_offset, interpret: bool = False):
+    """Presliced packed garble (the per-shard form of
+    :func:`garble_equality_payload_packed`): the caller supplies the
+    garbler labels + mask instead of a seed.  Returns the raveled planar
+    buffer for this extent."""
+    return _garble_packed(
+        jnp.asarray(R, jnp.uint32), jnp.asarray(Y0, jnp.uint32),
+        jnp.asarray(X0, jnp.uint32), jnp.asarray(mask, jnp.uint32),
+        jnp.asarray(x_bits, bool), jnp.asarray(m_v0, jnp.uint32),
+        jnp.asarray(m_v1, jnp.uint32), idx_offset,
+        jnp.asarray(x_bits, bool).shape[1], n_words, interpret,
+    )

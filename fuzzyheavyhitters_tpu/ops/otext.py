@@ -133,6 +133,45 @@ _receiver_extend = partial(jax.jit, static_argnames=("m",))(
 _sender_extend = partial(jax.jit, static_argnames=("m",))(_sender_extend_core)
 
 
+# Row-sharded extension (the multi-chip kernel stage,
+# parallel/kernel_shard.py): the column PRG streams are CTR-mode and the
+# packed butterfly transpose is word-local, so rows [row0, row0 + m) of a
+# full-width extension are computable independently given only the
+# matching column-word slice — shard i of a shard_mapped extension calls
+# these with its own (row0, m) and reproduces EXACTLY the rows a
+# single-device extend of the whole batch would have produced (the wire
+# and every pad index stay byte-identical; tier-1 asserts it).
+#
+# Alignment contract: ``row0`` must be a multiple of 512 rows (= 16
+# stream words = one ChaCha block per column), so the per-shard stream
+# reads start on a block boundary; ``m`` must be a multiple of 32 so the
+# column-word slice is exact.  Both are static facts of the planar shard
+# layout (shards are whole 8192-test planar blocks and S >= 1), checked
+# by the caller — row0 itself may be a TRACED value (lax.axis_index).
+# Rows past the session's real batch read stream blocks the cursor has
+# not consumed yet (the uniform per-shard shape covers the planar pad
+# region); callers MUST zero-mask those rows before anything derived
+# from them becomes wire-visible, and the session cursor only ever
+# advances by the real batch (:meth:`OtExtSender.advance`).
+
+
+def sender_extend_rows(seeds, s_bits, u_cols, base_off, row0, m: int):
+    """Q rows [row0, row0 + m) of a full extension: ``u_cols`` is the
+    column-word slice ``u[:, row0//32 : row0//32 + m//32]``; ``base_off``
+    is the session's pre-batch stream block offset
+    (:attr:`OtExtSender.stream_offset`)."""
+    return _sender_extend_core(seeds, s_bits, u_cols, base_off + row0 // 512, m)
+
+
+def receiver_extend_rows(seeds0, seeds1, choices, base_off, row0, m: int):
+    """(u column-word slice, T rows) for rows [row0, row0 + m): the
+    receiver twin of :func:`sender_extend_rows` (``choices`` is the
+    shard's own m choice bits)."""
+    return _receiver_extend_core(
+        seeds0, seeds1, choices, base_off + row0 // 512, m
+    )
+
+
 # Fused extension+hash: the column PRG, the u-XOR, the packed butterfly
 # transpose, and the chosen-payload pad hash of one batch as a SINGLE
 # jitted program per role — one device dispatch, no [m, 4] row tensor
@@ -281,12 +320,32 @@ class OtExtSender:
         batch (both endpoints' ``consumed`` advance in lockstep)."""
         return self._sent
 
-    def extend(self, m: int, u_msg) -> jax.Array:
-        """Peer's u-matrix -> Q rows uint32[m, 4] (Q_j = T_j ^ r_j·s)."""
-        q = _sender_extend(self._seeds, self._s_dev, jnp.asarray(u_msg), self._off, m)
+    @property
+    def stream_offset(self) -> int:
+        """Per-column stream position in ChaCha blocks — the ``base_off``
+        a row-sharded extension (:func:`sender_extend_rows`) seeks from."""
+        return self._off
+
+    @property
+    def shard_state(self) -> tuple:
+        """(seeds, s_bits device array) — the raw extension state a
+        shard_mapped row-sharded extend consumes (parallel/kernel_shard)."""
+        return self._seeds, self._s_dev
+
+    def advance(self, m: int) -> None:
+        """Advance the session cursors past an ``m``-row batch extended
+        OUT-OF-BAND (the row-sharded extension computes the rows itself
+        from :attr:`shard_state`): identical bookkeeping to
+        :meth:`extend`, so a sharded endpoint stays in lockstep with a
+        single-device peer."""
         w = -(-m // 32)
         self._off += -(-w // 16)  # blocks consumed from each column stream
         self._sent += m
+
+    def extend(self, m: int, u_msg) -> jax.Array:
+        """Peer's u-matrix -> Q rows uint32[m, 4] (Q_j = T_j ^ r_j·s)."""
+        q = _sender_extend(self._seeds, self._s_dev, jnp.asarray(u_msg), self._off, m)
+        self.advance(m)
         return q
 
     def pads(self, q_rows: jax.Array, n_words: int, idx_offset: int):
@@ -306,9 +365,7 @@ class OtExtSender:
             self._seeds, self._s_dev, jnp.asarray(self.s_block),
             jnp.asarray(u_msg), self._off, self._sent, m, n_words, domain,
         )
-        w = -(-m // 32)
-        self._off += -(-w // 16)
-        self._sent += m
+        self.advance(m)
         return q, p0, p1
 
 
@@ -329,15 +386,30 @@ class OtExtReceiver:
         """Total OTs extended so far (see OtExtSender.consumed)."""
         return self._recv
 
+    @property
+    def stream_offset(self) -> int:
+        """Stream position in blocks (see OtExtSender.stream_offset)."""
+        return self._off
+
+    @property
+    def shard_state(self) -> tuple:
+        """(seeds0, seeds1) for a row-sharded extend
+        (:func:`receiver_extend_rows`, parallel/kernel_shard)."""
+        return self._seeds0, self._seeds1
+
+    def advance(self, m: int) -> None:
+        """Out-of-band cursor bookkeeping (see OtExtSender.advance)."""
+        w = -(-m // 32)
+        self._off += -(-w // 16)
+        self._recv += m
+
     def extend(self, choices) -> tuple[jax.Array, jax.Array]:
         """choices bool[m] -> (u message uint32[128, ceil(m/32)],
         T rows uint32[m, 4]).  T_j is the Δ-OT label for choice r_j."""
         choices = jnp.asarray(choices, bool)
         m = choices.shape[0]
         u, t = _receiver_extend(self._seeds0, self._seeds1, choices, self._off, m)
-        w = -(-m // 32)
-        self._off += -(-w // 16)
-        self._recv += m
+        self.advance(m)
         return u, t
 
     def pads(self, t_rows: jax.Array, n_words: int, idx_offset: int) -> jax.Array:
@@ -355,9 +427,7 @@ class OtExtReceiver:
             self._seeds0, self._seeds1, choices, self._off, self._recv,
             m, n_words, domain,
         )
-        w = -(-m // 32)
-        self._off += -(-w // 16)
-        self._recv += m
+        self.advance(m)
         return u, t, pad
 
 

@@ -26,6 +26,15 @@ protocol/secure.py (``ot2s_encrypt``/``ot2s_decrypt``) compute identical
 bits — the planar wire buffers are word-for-word engine-independent, and
 tests/test_secure_kernels.py pins parity in interpret mode on CPU.
 
+Row-sharded use (parallel/kernel_shard.py): both kernels are presliced-
+input programs already — each mesh shard calls :func:`ot2s_encrypt` /
+:func:`ot2s_decrypt` on its own whole-planar-block slice of the level
+under ``shard_map``, with ``idx_offset`` = session base + the shard's
+global test offset (a traced ``lax.axis_index`` expression; it rides
+SMEM).  Shard extents are whole R_BLK*GROUP blocks, so ``padded_tests``
+is the identity per shard and the per-shard planar buffers concatenate
+along the row axis into the byte-identical single-device wire.
+
 Ref seam: ocelot's chosen-payload OT consumption in src/collect.rs:439-471,
 generalized from per-wire 1-of-2 to the per-test 1-of-2^S equality table.
 """

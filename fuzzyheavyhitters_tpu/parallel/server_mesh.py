@@ -191,13 +191,36 @@ class ServerMesh:
 
     def gather(self, arr):
         """Collapse a client-axis-sharded array back onto ONE device
-        (the mesh's first).  The GC/OT kernel stage is single-device by
-        design — the planar Pallas engines take no sharded operands, so
-        the packed share bits gather over ICI before string extraction
-        (sharding the kernel stage itself is the ROADMAP phase-2 item).
-        A pure layout move: values are untouched, bit-identity holds by
+        (the mesh's first).  Since PR 10 this is only the kernel stage's
+        DEGRADED path: a level whose planar batch yields a single kernel
+        shard (``kernel_bind`` -> None) still gathers the packed share
+        bits over ICI before string extraction; any level with >= 2
+        whole planar blocks runs the row-sharded kernel stage
+        (parallel/kernel_shard.py) and never touches this.  A pure
+        layout move: values are untouched, bit-identity holds by
         construction."""
         return jax.device_put(arr, self.devices[0])
+
+    def kernel_budget(self, requested: int) -> int:
+        """Device budget for the secure kernel stage
+        (``Config.secure_kernel_shards``): 0 = auto follows the bound
+        data shards; explicit requests cap at them (the kernel mesh is a
+        leading submesh of the data mesh)."""
+        if requested <= 0:
+            return self.shards
+        return max(1, min(int(requested), self.shards))
+
+    def kernel_bind(self, B: int, S: int, requested: int):
+        """Bind the row-sharded kernel stage for a ``B``-test level
+        (parallel/kernel_shard.KernelShard), or None when the batch only
+        fills one planar block per the budget — the caller then keeps
+        the :meth:`gather` path.  Pure (lru-cached mesh machinery
+        underneath): safe from the unlocked frame-arrival pre-expand."""
+        from . import kernel_shard
+
+        return kernel_shard.bind(
+            self._active_devices(), B, S, self.kernel_budget(requested)
+        )
 
     # -- ICI reductions (the pre-wire psum hooks) -------------------------
 

@@ -82,7 +82,7 @@ from ..obs import metrics as obsmetrics
 from ..ops import baseot, dpf, gc, ibdcf, otext, prg
 from ..ops.fields import F255, FE62
 from ..ops.ibdcf import EvalState, IbDcfKeyBatch
-from ..parallel import server_mesh as smesh
+from ..parallel import kernel_shard, server_mesh as smesh
 from ..resilience import admission as resadmission
 from ..resilience import chaos as reschaos
 from ..resilience import policy as respolicy
@@ -937,13 +937,40 @@ class CollectorServer:
         if self.cfg.secure_exchange:
             d = self.keys.cw_seed.shape[1]
             if self._mesh is not None:
-                # the 2PC kernel stage runs single-device by design:
-                # gather the packed share bits over ICI before string
-                # extraction — on accelerator hosts the planar Pallas
-                # engines take no sharded operands (CPU tier-1 cannot
-                # catch that: the XLA twins tolerate sharded inputs).
-                # Sharding the kernel stage itself is ROADMAP phase 2.
+                # row-sharded kernel stage (parallel/kernel_shard.py):
+                # the whole-level planar test batch partitions along its
+                # row/block axis across the data mesh — extension,
+                # equality kernels, and b2a all run per shard with a
+                # byte-identical wire, so nothing between FSS expansion
+                # and the frame serializes onto one device
+                F_, N = packed.shape
+                C = 1 << d
+                B = F_ * C * N
+                ks = self._mesh.kernel_bind(
+                    B, 2 * d, self.cfg.secure_kernel_shards
+                )
+                if ks is not None:
+                    out["flat"] = kernel_shard.shard_flat(
+                        ks, packed, d, F_, N
+                    )
+                    out["dims"] = (F_, C, N, 2 * d)
+                    out["kernel"] = ks
+                    return out
+                # degraded path (batch fills a single planar block, or
+                # secure_kernel_shards pins 1): the pre-PR-10 gather of
+                # the packed share bits over ICI onto one device.  The
+                # counter is the layout detector (a fully sharded crawl
+                # never increments it); the timer records DISPATCH time
+                # only — device_put returns before the transfer, which
+                # completes lazily under the level's later fetch, so a
+                # sync here would block this (possibly frame-arrival)
+                # context for a full tunnel RTT
+                t0 = time.monotonic()
                 packed = self._mesh.gather(packed)
+                self.obs.timer_add(
+                    "kernel_gather", time.monotonic() - t0, level=int(level)
+                )
+                self.obs.count("kernel_gathers", level=int(level))
             strs = secure.child_strings(packed, d)  # [F, C, N, S]
             F_, C, N, S = strs.shape
             out["flat"] = strs.reshape(F_ * C * N, S)
@@ -1109,68 +1136,142 @@ class CollectorServer:
             path = secure.ot_path(S, ot_path or self.cfg.ot_path)
             self.obs.count(f"ot_path_{path}", level=level)
             W = secure.payload_words(count_field)
+            ks = ex.get("kernel")
+            if self._mesh is not None:
+                # per-level kernel layout: the active row-shard count (1
+                # = the degraded gather path) feeds the mesh report
+                # section and the acceptance gate (kernel_gather ~ 0)
+                self.obs.gauge(
+                    "kernel_shards", ks.k if ks is not None else 1,
+                    level=level,
+                )
             if self.server_id == garbler:  # garbler/sender + OT-ext sender
                 u = await self._dp_recv()
-                with self.obs.span("otext", level=level):
-                    idx0 = self._ot_snd.consumed
-                    q = self._ot_snd.extend(B * S, u)
-                    await self._phase_sync(q)
-                with self.obs.span("b2a", level=level):
-                    vals, w0, w1 = secure.b2a_payload_pair(
-                        count_field, b2a_seed, B, garbler
+                if ks is not None:
+                    # ROW-SHARDED kernel stage: extension, payload pair,
+                    # and the equality kernel all run per mesh shard
+                    # (parallel/kernel_shard.py); the frame reads back
+                    # per shard and reassembles positionally — nothing
+                    # gathers onto one device
+                    with self.obs.span("otext", level=level):
+                        q, idx0 = kernel_shard.snd_extend(
+                            ks, self._ot_snd, u
+                        )
+                        await self._phase_sync(q)
+                    kphase = "b2a" if path == "ot2s" else "garble"
+                    with self.obs.span(kphase, level=level):
+                        planes, vals = kernel_shard.gb_kernel(
+                            ks, self._ot_snd.s_block, q, flat, gc_seed,
+                            b2a_seed, count_field, garbler, path, idx0,
+                        )
+                        await self._phase_sync(planes)
+                    self._zero_phases(
+                        level, "eval",
+                        *(("garble",) if path == "ot2s" else ("b2a",)),
                     )
-                    if path == "ot2s":
-                        msg = secure.ot2s_encrypt_packed(
-                            q.reshape(B, S, 4),
-                            jnp.asarray(self._ot_snd.s_block), flat, w1, w0,
-                            W, idx0,
-                        )
-                    await self._phase_sync(w1 if path != "ot2s" else msg)
-                if path == "ot2s":
-                    self._zero_phases(level, "garble", "eval")
+                    self.obs.count("device_fetches", ks.k, level=level)
+                    # msg_wire starts the per-shard D2H copies itself
+                    msg_np = await asyncio.to_thread(
+                        kernel_shard.msg_wire, ks, planes
+                    )
+                    await self._dp_send(msg_np)
                 else:
-                    with self.obs.span("garble", level=level):
-                        msg, _ = gc.garble_equality_payload_packed(
-                            jnp.asarray(self._ot_snd.s_block),
-                            q.reshape(B, S, 4), jnp.asarray(gc_seed), flat,
-                            w1, w0, W, idx0,
+                    with self.obs.span("otext", level=level):
+                        idx0 = self._ot_snd.consumed
+                        q = self._ot_snd.extend(B * S, u)
+                        await self._phase_sync(q)
+                    with self.obs.span("b2a", level=level):
+                        vals, w0, w1 = secure.b2a_payload_pair(
+                            count_field, b2a_seed, B, garbler
                         )
-                        await self._phase_sync(msg)
-                    self._zero_phases(level, "eval")
-                await self._dp_send(await _fetch(msg, self.obs))
+                        if path == "ot2s":
+                            msg = secure.ot2s_encrypt_packed(
+                                q.reshape(B, S, 4),
+                                jnp.asarray(self._ot_snd.s_block), flat,
+                                w1, w0, W, idx0,
+                            )
+                        await self._phase_sync(w1 if path != "ot2s" else msg)
+                    if path == "ot2s":
+                        self._zero_phases(level, "garble", "eval")
+                    else:
+                        with self.obs.span("garble", level=level):
+                            msg, _ = gc.garble_equality_payload_packed(
+                                jnp.asarray(self._ot_snd.s_block),
+                                q.reshape(B, S, 4), jnp.asarray(gc_seed),
+                                flat, w1, w0, W, idx0,
+                            )
+                            await self._phase_sync(msg)
+                        self._zero_phases(level, "eval")
+                    await self._dp_send(await _fetch(msg, self.obs))
             else:  # evaluator + OT receiver (inputs stay on device: each
                 # np.asarray here would cost a full tunnel round trip)
-                with self.obs.span("otext", level=level):
-                    u, t_rows, idx0 = secure.ev_step1_fused(self._ot_rcv, flat)
-                    u_np = await _fetch(u, self.obs)  # forces the extension
-                await self._dp_send(u_np)
-                bmsg = await self._dp_recv()
-                if path == "ot2s":
-                    with self.obs.span("b2a", level=level):
-                        pay = secure.ot2s_decrypt_packed(
-                            jnp.asarray(t_rows).reshape(B, S, 4), flat,
-                            bmsg, W, idx0,
+                if ks is not None:
+                    with self.obs.span("otext", level=level):
+                        u_arr, t_rows, idx0 = kernel_shard.rcv_extend(
+                            ks, self._ot_rcv, flat
                         )
-                        vals = secure.words_to_field(count_field, pay)
+                        self.obs.count("device_fetches", ks.k, level=level)
+                        # u_wire starts the per-shard D2H copies itself
+                        u_np = await asyncio.to_thread(
+                            kernel_shard.u_wire, ks, u_arr
+                        )
+                    await self._dp_send(u_np)
+                    bmsg = await self._dp_recv()
+                    kphase = "b2a" if path == "ot2s" else "eval"
+                    with self.obs.span(kphase, level=level):
+                        vals = kernel_shard.ev_open(
+                            ks, t_rows, flat, bmsg, count_field, path, idx0
+                        )
                         await self._phase_sync(vals)
-                    self._zero_phases(level, "garble", "eval")
+                    self._zero_phases(
+                        level, "garble",
+                        *(("eval",) if path == "ot2s" else ("b2a",)),
+                    )
                 else:
-                    with self.obs.span("eval", level=level):
-                        _, pay = gc.eval_equality_payload_packed(
-                            bmsg, jnp.asarray(t_rows).reshape(B, S, 4), W,
-                            idx0,
+                    with self.obs.span("otext", level=level):
+                        u, t_rows, idx0 = secure.ev_step1_fused(
+                            self._ot_rcv, flat
                         )
-                        await self._phase_sync(pay)
-                    with self.obs.span("b2a", level=level):
-                        vals = secure.words_to_field(count_field, pay)
-                        await self._phase_sync(vals)
-                    self._zero_phases(level, "garble")
+                        u_np = await _fetch(u, self.obs)  # forces the extension
+                    await self._dp_send(u_np)
+                    bmsg = await self._dp_recv()
+                    if path == "ot2s":
+                        with self.obs.span("b2a", level=level):
+                            pay = secure.ot2s_decrypt_packed(
+                                jnp.asarray(t_rows).reshape(B, S, 4), flat,
+                                bmsg, W, idx0,
+                            )
+                            vals = secure.words_to_field(count_field, pay)
+                            await self._phase_sync(vals)
+                        self._zero_phases(level, "garble", "eval")
+                    else:
+                        with self.obs.span("eval", level=level):
+                            _, pay = gc.eval_equality_payload_packed(
+                                bmsg, jnp.asarray(t_rows).reshape(B, S, 4),
+                                W, idx0,
+                            )
+                            await self._phase_sync(pay)
+                        with self.obs.span("b2a", level=level):
+                            vals = secure.words_to_field(count_field, pay)
+                            await self._phase_sync(vals)
+                        self._zero_phases(level, "garble")
         with self.obs.span("field", level=level) as sp_field:
-            vals = vals.reshape((F_, C, N) + count_field.limb_shape)
-            shares = await self._reduced_fetch(
-                level, secure.node_share_sums,
-                count_field, vals, jnp.asarray(w),
-            )
+            if ks is not None:
+                # test-sharded b2a shares: scatter into the (F, C, N)
+                # frame per shard, alive-gate, and psum back over ICI —
+                # the kernel-stage twin of ServerMesh.node_share_sums
+                self.obs.gauge("data_shards", self._mesh.shards, level=level)
+                with self.obs.span("ici_reduce", level=level):
+                    out = kernel_shard.share_sums(
+                        ks, count_field, vals, w, F_, C, N
+                    )
+                    shares = await _fetch(out, self.obs)
+            else:
+                vals = vals.reshape((F_, C, N) + count_field.limb_shape)
+                shares = await self._reduced_fetch(
+                    level, secure.node_share_sums,
+                    count_field, vals, jnp.asarray(w),
+                )
         self._emit_level_phases(level, sp_fss, sp_gc, sp_field)
         self._stash_children(level, shard, children)
         return shares
@@ -1648,6 +1749,20 @@ class CollectorServer:
             ),
             "reshards": int(self.obs.counter_value("mesh_reshards")),
             "faults": int(self.obs.counter_value("mesh_faults")),
+            # row-sharded secure kernel stage (parallel/kernel_shard.py):
+            # the last level's active shard count / the crawl's deepest
+            # (None before any secure crawl).  The LAYOUT signal is the
+            # gauge + the kernel_gathers counter (exactly the levels
+            # that ran the degraded gather path — 0 of them on a fully
+            # sharded crawl); kernel_gather_seconds is that gather's
+            # DISPATCH time (the transfer itself completes lazily under
+            # the level's later fetch), a supplement, not the detector
+            "kernel_shards": self.obs.gauge_value("kernel_shards"),
+            "kernel_shards_max": self.obs.gauge_max("kernel_shards"),
+            "kernel_gathers": int(self.obs.counter_value("kernel_gathers")),
+            "kernel_gather_seconds": round(
+                self.obs.timer_seconds("kernel_gather"), 6
+            ),
         }
 
     def _ckpt_levels(self) -> list:
@@ -2289,6 +2404,25 @@ class CollectorServer:
                 use_pallas=False if mesh is not None else None,
             )
             if self.cfg.secure_exchange:
+                N = self.keys.cw_seed.shape[0]
+                ks = (
+                    mesh.kernel_bind(
+                        fb * (1 << d) * N, 2 * d,
+                        self.cfg.secure_kernel_shards,
+                    )
+                    if mesh is not None
+                    else None
+                )
+                if ks is not None:
+                    # the live crawl runs this shape ROW-SHARDED: warm
+                    # the sharded flat/extension/kernel/open/psum chain
+                    # (both roles, both garbling signs) — warming the
+                    # gathered twins would leave every live program cold
+                    secure.warm_level_kernels_sharded(
+                        ks, packed, d, fb, N, F255 if last else FE62,
+                        path=ot_path or self.cfg.ot_path,
+                    )
+                    continue
                 secure.warm_level_kernels(
                     # same pre-kernel gather as the live expand stage
                     # (_do_expand) — warm and live must dispatch the

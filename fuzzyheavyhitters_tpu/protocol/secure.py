@@ -752,3 +752,35 @@ def warm_level_kernels(packed, d: int, field, path: str = "auto",
     jax.block_until_ready(
         reduce_fn(field, vals.reshape((F_, C, N) + field.limb_shape), w)
     )
+
+
+def warm_level_kernels_sharded(ks, packed, d: int, F: int, N: int, field,
+                               path: str = "auto") -> None:
+    """The :func:`warm_level_kernels` contract for a ROW-SHARDED kernel
+    level (parallel/kernel_shard.py): compile the sharded flat builder,
+    both roles of the row-sharded extension, the per-shard equality
+    kernel at BOTH garbling signs (the live crawl alternates the garbler
+    per level and the sign is a static of the compiled program), the
+    per-shard open, and the scatter + ICI-psum share-sum program — on
+    the same throwaway OT session, with the u-matrix and the planar
+    frame round-tripping through host numpy exactly like the live
+    socket path (per-shard assembly + re-upload included, so the
+    device_put placements match live).  ``packed`` arrives in its live
+    mesh sharding (the client-axis expansion layout)."""
+    from ..parallel import kernel_shard
+
+    flat = kernel_shard.shard_flat(ks, packed, d, F, N)
+    snd, rcv = _warm_pair()
+    zero = np.zeros(4, np.uint32)
+    gseed, bseed = derive_seed(zero, 1, 0), derive_seed(zero, 2, 0)
+    p = ot_path(2 * d, path)
+    vals_r = None
+    for g in (0, 1):
+        _, _, _, vals_r = kernel_shard.run_level_pair(
+            ks, snd, rcv, flat, flat, gseed, bseed, field, g, p
+        )
+    C = 1 << d
+    w = np.ones((F, C, N), bool)
+    jax.block_until_ready(
+        kernel_shard.share_sums(ks, field, vals_r, w, F, C, N)
+    )

@@ -98,6 +98,18 @@ class Config:
     # Results are bit-identical at every setting: sharding is a physical
     # layout, the 2PC transcript never changes (asserted in tier-1).
     server_data_devices: int = 0
+    # multi-chip SECURE KERNEL stage (parallel/kernel_shard.py): how many
+    # of the server's data-mesh devices the whole-level 2PC kernels —
+    # row-sharded IKNP extension, 1-of-2^S / GC equality, b2a — shard
+    # over.  0 = auto: follow the mesh's data shards.  1 pins the
+    # single-device kernel path (the packed share bits gather over ICI
+    # before string extraction — the pre-PR-10 layout).  N > 1 caps the
+    # kernel shards at N; the ACTIVE count per level is the largest
+    # divisor of the level's planar block count (padded_tests(B)/8192)
+    # that fits, so a small batch degrades to fewer shards — ultimately
+    # to the gather path — instead of failing.  The wire is byte-
+    # identical at every setting (asserted in tier-1).
+    secure_kernel_shards: int = 0
     # per-level secure-kernel phase split (phase_otext/garble/eval/b2a
     # spans in the run report): True syncs the device at each phase
     # boundary so the spans carry real device time — the acceptance

@@ -76,6 +76,18 @@ assert "ici_reduce_seconds" in mc and "secure_clients_per_sec" in mc, (
     "multichip section missing ici_reduce_seconds / per-shard rates: "
     + last[:300]
 )
+assert (mc.get("kernel_shards") or 0) >= 2, (
+    "kernel-sharded legs never engaged (kernel_shards < 2 — the "
+    "row-sharded IKNP/equality stage, parallel/kernel_shard.py): "
+    + last[:300]
+)
+assert "kernel_clients_per_sec" in mc and "kernel_gather_seconds" in mc, (
+    "multichip section missing the kernel-sharded leg keys: " + last[:300]
+)
+assert mc.get("whole_level_speedup_vs_gathered") is not None, (
+    "whole_level_speedup_vs_gathered missing (sharded-vs-gathered "
+    "kernel comparison): " + last[:300]
+)
 print(
     "bench_smoke OK: "
     f"{doc['metric']}={doc['value']}, "
@@ -85,6 +97,8 @@ print(
     f"ingest_keys_per_sec={ing['ingest_keys_per_sec']}, "
     f"multichip_shards={mc['data_shards']} "
     f"(rates={mc['secure_clients_per_sec']}), "
+    f"kernel_shards={mc['kernel_shards']} "
+    f"(speedup_vs_gathered={mc['whole_level_speedup_vs_gathered']}), "
     f"line={len(last)}B, elapsed={doc.get('budget', {}).get('elapsed_s')}s"
 )
 EOF

@@ -19,7 +19,7 @@ import pytest
 
 from fuzzyheavyhitters_tpu.obs import report as obsreport
 from fuzzyheavyhitters_tpu.ops import ibdcf
-from fuzzyheavyhitters_tpu.parallel import server_mesh
+from fuzzyheavyhitters_tpu.parallel import kernel_shard, server_mesh
 from fuzzyheavyhitters_tpu.protocol import rpc, sketch
 from fuzzyheavyhitters_tpu.protocol.leader_rpc import RpcLeader
 from fuzzyheavyhitters_tpu.ops.fields import F255, FE62
@@ -80,7 +80,8 @@ def sketch_keys(client_keys):
 
 
 async def _crawl(cfg, port, k0, k1, sk0=None, sk1=None, *, warmup=False,
-                 chaos=None, ckpt_dir=None, supervised=False):
+                 chaos=None, ckpt_dir=None, supervised=False,
+                 n_clients=N_CLIENTS):
     s0 = rpc.CollectorServer(0, cfg, ckpt_dir=ckpt_dir, _mesh_chaos=chaos)
     s1 = rpc.CollectorServer(1, cfg, ckpt_dir=ckpt_dir)
     t1 = asyncio.create_task(
@@ -97,7 +98,7 @@ async def _crawl(cfg, port, k0, k1, sk0=None, sk1=None, *, warmup=False,
     try:
         if supervised:
             res = await lead.run_supervised(
-                N_CLIENTS, k0, k1, sk0, sk1, checkpoint_every=1,
+                n_clients, k0, k1, sk0, sk1, checkpoint_every=1,
                 warmup=warmup,
             )
         else:
@@ -105,7 +106,7 @@ async def _crawl(cfg, port, k0, k1, sk0=None, sk1=None, *, warmup=False,
             await lead.upload_keys(k0, k1, sk0, sk1)
             if warmup:
                 await lead.warmup()
-            res = await lead.run(N_CLIENTS)
+            res = await lead.run(n_clients)
         status0 = await c0.call("status")
         report = obsreport.run_report([s0.obs, s1.obs, lead.obs])
     finally:
@@ -231,6 +232,122 @@ def test_device_loss_without_checkpoint_escalates(client_keys):
     cfg = _cfg(port, server_data_devices=2)
     with pytest.raises(RuntimeError, match="no level-1 checkpoint"):
         _run(cfg, port, k0, k1, chaos=chaos)
+
+
+L_K, N_K = 4, 1024  # kernel-sharded e2e shape: the last level's
+# bucket-8 rung puts 16384 tests on the planar frame (2 blocks), so
+# the deep level runs the ROW-SHARDED kernel stage while the shallow
+# ones degrade to the gather path — both layouts in one crawl
+
+
+@pytest.fixture(scope="module")
+def kernel_keys():
+    rng = np.random.default_rng(99)
+    sites = np.arange(8) * 2  # spread leaves: >= 8 distinct paths
+    pts = sites[rng.integers(0, 8, size=N_K)]
+    pts_bits = np.array(
+        [[bitutils.int_to_bits(L_K, int(v)) for v in row]
+         for row in pts[:, None]]
+    )
+    return ibdcf.gen_l_inf_ball(pts_bits, 1, rng, engine="np")
+
+
+def _kcfg(port, **kw):
+    defaults = dict(
+        data_len=L_K, n_dims=1, ball_size=1, addkey_batch_size=1024,
+        num_sites=8, threshold=0.02, zipf_exponent=1.03,
+        server0=f"127.0.0.1:{port}", server1=f"127.0.0.1:{port + 10}",
+        distribution="zipf", f_max=16, secure_exchange=True,
+    )
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+def test_kernel_shard_binding_degrades():
+    """A non-dividing planar batch degrades to fewer KERNEL shards
+    instead of failing: the active count is the largest divisor of the
+    block count that fits the budget, 1 = the gather path."""
+    blk = kernel_shard.BLOCK
+    assert kernel_shard.kernel_shards(8 * blk, 8) == 8
+    assert kernel_shard.kernel_shards(6 * blk, 4) == 3  # 4 ∤ 6 -> 3
+    assert kernel_shard.kernel_shards(3 * blk, 2) == 1  # prime-ish -> 1
+    assert kernel_shard.kernel_shards(blk - 5, 8) == 1  # one block
+    assert kernel_shard.kernel_shards(2 * blk, 0) == 1  # budget floor
+    import jax
+
+    devs = tuple(jax.devices()[:4])
+    assert kernel_shard.bind(devs, blk, 2, 4) is None  # 1 shard = gather
+    ks = kernel_shard.bind(devs, 2 * blk - 7, 2, 4)
+    assert ks is not None and ks.k == 2 and ks.bp == 2 * blk
+
+
+def test_kernel_sharded_crawl_bit_identical_with_device_kill(kernel_keys):
+    """THE kernel-stage e2e: a crawl whose deep levels row-shard the
+    secure kernels is bit-identical to the single-device crawl, shows
+    the degradation ladder in the report (shallow levels gather at
+    kernel_shards 1, deep levels shard at >= 2, kernel_gather ~ 0), and
+    a device KILL at a kernel-sharded level recovers by in-server
+    re-shard — levels_rerun stays ZERO."""
+    k0, k1 = kernel_keys
+    port = BASE_PORT + 5000
+    base, st_b, _ = _run(
+        _kcfg(port, server_data_devices=1), port, k0, k1, n_clients=N_K,
+    )
+    assert st_b["mesh"] is None
+    chaos = MeshChaos(parse_mesh_faults("mesh:kill@level=3"))
+    with tempfile.TemporaryDirectory() as td:
+        res, status0, report = _run(
+            _kcfg(port + 1200, server_data_devices=4), port + 1200,
+            k0, k1, chaos=chaos, ckpt_dir=td, supervised=True,
+            n_clients=N_K,
+        )
+    assert chaos.fired == [("kill", 3)]
+    np.testing.assert_array_equal(base.paths, res.paths)
+    np.testing.assert_array_equal(base.counts, res.counts)
+    rec = report["recovery"]
+    assert rec["shards_rerun"] >= 1
+    assert rec["levels_rerun"] == 0
+    mesh = report["mesh"]
+    by = mesh["by_level"]
+    # degradation ladder: level 0 (one node) gathers, the deep levels
+    # run the sharded kernel stage
+    assert by["0"]["kernel_shards"] == 1
+    deep = max(v.get("kernel_shards", 0) for v in by.values())
+    assert deep >= 2, f"kernel stage never sharded: {by}"
+    assert mesh["kernel_shards"] >= 2  # last level's layout
+    # the gather survives only on the shallow one-block levels: the
+    # counter names them (the layout detector), and its cumulative
+    # dispatch time must be noise, not a per-level stage
+    assert mesh["kernel_gathers"] >= 1
+    assert mesh["kernel_gather_seconds"] < 1.0
+    assert status0["mesh"]["kernel_shards"] >= 2
+    assert status0["mesh"]["kernel_shards_max"] >= 2
+    assert status0["mesh"]["kernel_gather_seconds"] < 1.0
+    sk = report["secure_kernels"]
+    assert sk["kernel_shards"] >= 2
+    assert sk["otext_seconds"] > 0 and sk["b2a_seconds"] > 0
+
+
+def test_warmed_kernel_sharded_crawl_zero_fresh_compiles(kernel_keys):
+    """The warmup contract extends to the ROW-SHARDED kernel ladder:
+    after one warmed kernel-sharded secure crawl, a second identically-
+    shaped warmed crawl triggers ZERO fresh XLA compiles — warmup
+    compiles the sharded flat/extension/kernel/open/psum programs (both
+    roles, both garbling signs) the live crawl dispatches."""
+    from fuzzyheavyhitters_tpu.utils import compile_cache
+
+    k0, k1 = kernel_keys
+    port = BASE_PORT + 6000
+    kw = dict(server_data_devices=4)
+    _run(_kcfg(port, **kw), port, k0, k1, warmup=True, n_clients=N_K)
+    before = compile_cache.backend_compiles()
+    _, status0, _ = _run(_kcfg(port + 1200, **kw), port + 1200, k0, k1,
+                         warmup=True, n_clients=N_K)
+    fresh = compile_cache.backend_compiles() - before
+    assert status0["mesh"]["kernel_shards"] >= 2  # the ladder engaged
+    assert fresh == 0, (
+        f"{fresh} fresh compiles in a warmed kernel-sharded crawl"
+    )
 
 
 def test_warmed_multichip_crawl_zero_fresh_compiles(client_keys):
