@@ -1494,6 +1494,148 @@ def bench_ingest(n=65536, L=12, chunk=256, port=39931, threshold=0.05):
     return out
 
 
+def bench_multitenant(n=1024, L=10, port=40531, tenant_counts=(1, 2, 4),
+                      threshold=0.05):
+    """Multi-tenant collection sessions (protocol/sessions.py): N
+    concurrent collections on ONE server pair, each its own session
+    (own frontier, own OT streams, own ingest gate), device work
+    interleaved by the TenantScheduler.  Reports aggregate SECURE
+    clients/sec at 1/2/4 concurrent collections vs the solo baseline,
+    plus the stall-fill ratio (device turns that ran while another
+    tenant waited on the GC/OT wire — the ``pipeline_stalls`` gap a
+    second tenant fills).  Every tenant's heavy-hitter set is asserted
+    BIT-IDENTICAL to its solo single-session run before anything is
+    reported."""
+    import asyncio
+
+    from fuzzyheavyhitters_tpu.ops import ibdcf
+    from fuzzyheavyhitters_tpu.protocol import rpc
+    from fuzzyheavyhitters_tpu.protocol.leader_rpc import (
+        MultiCollectionDriver,
+    )
+    from fuzzyheavyhitters_tpu.utils.config import Config
+
+    def mkcfg(p):
+        return Config(
+            data_len=L, n_dims=1, ball_size=1, addkey_batch_size=2048,
+            num_sites=8, threshold=threshold, zipf_exponent=1.03,
+            server0=f"127.0.0.1:{p}", server1=f"127.0.0.1:{p + 10}",
+            distribution="zipf", f_max=64, backend="cpu",
+            secure_exchange=True,
+        )
+
+    max_t = max(tenant_counts)
+    keysets = []
+    for i in range(max_t):
+        r = np.random.default_rng(50 + i)
+        sites = r.integers(0, 1 << L, size=8)
+        pts = sites[r.integers(0, 8, size=n)]
+        pts_bits = (
+            ((pts[:, None, None] >> np.arange(L - 1, -1, -1)) & 1) > 0
+        )
+        keysets.append(ibdcf.gen_l_inf_ball(pts_bits, 1, r, engine="np"))
+
+    async def _pair(p):
+        cfg = mkcfg(p)
+        s0 = rpc.CollectorServer(0, cfg)
+        s1 = rpc.CollectorServer(1, cfg)
+        t1 = asyncio.create_task(
+            s1.start("127.0.0.1", p + 10, "127.0.0.1", p + 11)
+        )
+        await asyncio.sleep(0.05)
+        t0 = asyncio.create_task(
+            s0.start("127.0.0.1", p, "127.0.0.1", p + 11)
+        )
+        await asyncio.gather(t0, t1)
+        return cfg, s0, s1
+
+    async def leg(p, idxs):
+        """The collections named by keyset indices ``idxs``, concurrent
+        on one fresh pair; returns (results by collection, crawl wall
+        seconds, scheduler stats)."""
+        cfg, s0, s1 = await _pair(p)
+        drv = MultiCollectionDriver(
+            cfg, "127.0.0.1", p, "127.0.0.1", p + 10
+        )
+        leads = {}
+        for i in idxs:
+            key = f"t{i}" if len(idxs) > 1 else "default"
+            lead = await drv.open(key)
+            await lead.upload_keys(*keysets[i])
+            await lead.warmup()  # WarmLadder dedups across tenants
+            leads[key] = (lead, i)
+        t0 = time.perf_counter()
+        out = await asyncio.gather(
+            *(lead.run(n) for lead, _ in leads.values())
+        )
+        wall = time.perf_counter() - t0
+        st = await next(iter(leads.values()))[0].c0.call("status")
+        await drv.close()
+        for s in (s0, s1):
+            await s.aclose()
+        results = {
+            key: res for (key, (_, i)), res in zip(leads.items(), out)
+        }
+        return results, wall, st["sessions"]["scheduler"]
+
+    # solo references: each keyset alone on a fresh pair
+    solo = {}
+    solo_wall = None
+    for i in range(max_t):
+        res, wall, _sched = asyncio.run(leg(port + 100 + 20 * i, [i]))
+        solo[i] = res["default"]
+        if i == 0:
+            solo_wall = wall
+    solo_rate = n / solo_wall
+
+    out = {
+        "n_clients_per_tenant": n,
+        "data_len": L,
+        "solo_clients_per_sec": round(solo_rate, 1),
+        "tenants": {},
+    }
+    for idx, k in enumerate(tenant_counts):
+        if k == 1:
+            out["tenants"]["1"] = {
+                "aggregate_clients_per_sec": round(solo_rate, 1),
+                "speedup_vs_solo": 1.0,
+                "stall_fill_ratio": 0.0,
+            }
+            continue
+        results, wall, sched = asyncio.run(
+            leg(port + 300 + 40 * idx, list(range(k)))
+        )
+        for i in range(k):
+            got = results[f"t{i}"]
+            want = solo[i]
+            if not (
+                np.array_equal(got.counts, want.counts)
+                and np.array_equal(got.paths, want.paths)
+            ):
+                raise AssertionError(
+                    f"tenant t{i} of the {k}-collection leg diverged "
+                    "from its solo run"
+                )
+        agg = k * n / wall
+        out["tenants"][str(k)] = {
+            "aggregate_clients_per_sec": round(agg, 1),
+            "speedup_vs_solo": round(agg / solo_rate, 3),
+            "stall_fill_ratio": sched["fill_ratio"],
+            "stall_fills": sched["stall_fills"],
+            "device_turns": sched["device_turns"],
+        }
+    top = str(max(tenant_counts))
+    out["aggregate_clients_per_sec"] = (
+        out["tenants"][top]["aggregate_clients_per_sec"]
+    )
+    out["aggregate_speedup_vs_solo"] = (
+        out["tenants"][top]["speedup_vs_solo"]
+    )
+    out["stall_fill_ratio"] = out["tenants"][top]["stall_fill_ratio"]
+    out["bit_identical_vs_solo"] = True
+    return out
+
+
 # sections of the run that already finished, keyed by metric name — what
 # the SIGTERM handler dumps so a timed-out bench still reports them
 _PARTIAL: dict = {}
@@ -1723,6 +1865,11 @@ _COMPACT_KEYS = {
         "bit_identical", "kernel_shards", "kernel_clients_per_sec",
         "kernel_gather_seconds", "whole_level_speedup_vs_gathered",
     ),
+    "multitenant": (
+        "aggregate_clients_per_sec", "aggregate_speedup_vs_solo",
+        "solo_clients_per_sec", "stall_fill_ratio",
+        "bit_identical_vs_solo",
+    ),
 }
 
 
@@ -1869,6 +2016,18 @@ def main():
             " threshold=0.2)))"
         ),
     )
+    multitenant = section(
+        "multitenant",
+        "import json, bench;print(json.dumps(bench.bench_multitenant()))",
+        # 4 solo legs + the 2- and 4-tenant legs, each a fresh secure
+        # server pair; warmup rides the shared WarmLadder + compile cache
+        timeout_s=900,
+        smoke_code=(
+            "import json, bench;"
+            "print(json.dumps(bench.bench_multitenant(n=64, L=6,"
+            " tenant_counts=(1, 2), threshold=0.2)))"
+        ),
+    )
     crawl_hbm_max = section(
         "crawl_hbm_max",
         "import json, numpy as np, bench;"
@@ -1900,6 +2059,7 @@ def main():
         "hash_margin": hash_margin,
         "upload": upload,
         "ingest": ingest,
+        "multitenant": multitenant,
     }
     head = {
         "metric": "ibdcf_keygen_keys_per_sec_at_data_len_512",

@@ -30,22 +30,28 @@ from dataclasses import dataclass, field
 # native, utils/compile_cache) are bound inline via `# fhh-guard:` — a
 # dotless key here would apply to every module in scope.
 _DEFAULT_GUARDS = {
-    # CollectorServer: everything the verb plane mutates serializes on
+    # CollectionSession (protocol/sessions.py): everything one
+    # collection's verb plane mutates serializes on the SESSION's own
     # _verb_lock; the deliberately-unlocked fast paths (add_keys /
-    # submit_keys / the frame-arrival pre-expand) carry VERIFIED
-    # `# fhh-race: atomic` contracts + runtime guards.unguarded()
-    # windows.
-    "CollectorServer.frontier": "_verb_lock",
-    "CollectorServer.keys": "_verb_lock",
-    "CollectorServer.keys_parts": "_verb_lock",
-    "CollectorServer.alive_keys": "_verb_lock",
-    "CollectorServer._expand_ready": "_verb_lock",
-    "CollectorServer._ingest_pools": "_verb_lock",
-    "CollectorServer._admission": "_verb_lock",
+    # submit_keys / the frame-arrival pre-expand / the session-table
+    # bind) carry VERIFIED `# fhh-race: atomic` contracts + runtime
+    # guards.unguarded() windows.
+    "CollectionSession.frontier": "_verb_lock",
+    "CollectionSession.keys": "_verb_lock",
+    "CollectionSession.keys_parts": "_verb_lock",
+    "CollectionSession.alive_keys": "_verb_lock",
+    "CollectionSession._children": "_verb_lock",
+    "CollectionSession._last_shares": "_verb_lock",
+    "CollectionSession._shard_children": "_verb_lock",
+    "CollectionSession._shard_last": "_verb_lock",
+    "CollectionSession._expand_ready": "_verb_lock",
+    "CollectionSession._ingest_pools": "_verb_lock",
+    "CollectionSession._admission": "_verb_lock",
+    "CollectionSession._sketch_parts": "_verb_lock",
+    "CollectionSession._sketch_root": "_verb_lock",
+    "CollectionSession._ratchet_digest": "_verb_lock",
+    # CollectorServer infra: the replay-dedup session table
     "CollectorServer._sessions": "_verb_lock",
-    "CollectorServer._sketch_parts": "_verb_lock",
-    "CollectorServer._sketch_root": "_verb_lock",
-    "CollectorServer._ratchet_digest": "_verb_lock",
     # WindowedIngest: gate-order == mirror-order state serializes on
     # _submit_lock (recovery additionally takes _recover_lock INSIDE it,
     # so every journal access holds _submit_lock)

@@ -129,8 +129,13 @@ async def _run(cfg, nreqs: int, rng) -> None:
 
     h0, p0 = _split(cfg.server0)
     h1, p1 = _split(cfg.server1)
-    c0 = await CollectorClient.connect(h0, p0)
-    c1 = await CollectorClient.connect(h1, p1)
+    # multi-tenant collection sessions: FHH_COLLECTION names the
+    # server-side session this leader's crawl runs in (protocol/
+    # sessions.py) — N leaders with distinct collections share one
+    # server pair concurrently; unset = the default session
+    collection = os.environ.get("FHH_COLLECTION") or None
+    c0 = await CollectorClient.connect(h0, p0, collection=collection)
+    c1 = await CollectorClient.connect(h1, p1, collection=collection)
 
     lead = RpcLeader(cfg, c0, c1)
     # per-f_bucket compile warmup (FHH_WARMUP=0 opts out): bucket

@@ -135,6 +135,9 @@ def run_report(registries=None) -> dict:
     mesh = _mesh_summary(out)
     if mesh is not None:
         doc["mesh"] = mesh
+    sess = _sessions_summary(out)
+    if sess is not None:
+        doc["sessions"] = sess
     if dropped:
         doc["dropped_registries"] = dropped
     return doc
@@ -426,6 +429,77 @@ def _mesh_summary(registries: dict) -> dict | None:
             }
             for lvl in levels
         },
+    }
+
+
+def _sessions_summary(registries: dict) -> dict | None:
+    """Cross-registry multi-tenant rollup (per-collection sessions,
+    protocol/sessions.py + tenancy.py): per collection, the crawl phase
+    seconds and ingest counters summed across its per-session
+    registries (named ``server{N}:{key}`` / ``leader:{key}``; the
+    default collection rides the bare ``server{N}``/``leader``
+    registries and is NOT broken out), plus the tenant scheduler's
+    device-turn/stall-fill accounting (``tenant_device_turns`` /
+    ``tenant_stall_fills`` on the server registries — a stall fill is a
+    device dispatch that ran while ANOTHER collection waited on the
+    GC/OT wire, i.e. the idle gap multi-tenancy exists to fill).
+    Present only when a multi-tenant run happened — single-collection
+    runs omit the section entirely."""
+    per: dict = {}
+    turns = fills = 0
+    seen = False
+    for name, snap in registries.items():
+        counters = snap.get("counters", {})
+        for cname, total_key in (
+            ("tenant_device_turns", "turns"),
+            ("tenant_stall_fills", "fills"),
+        ):
+            c = counters.get(cname)
+            if c is None:
+                continue
+            # turns/fills alone do NOT make the section present: every
+            # crawl takes device turns — only a per-session registry
+            # (a non-default collection) marks a multi-tenant run
+            if total_key == "turns":
+                turns += c.get("total", 0)
+            else:
+                fills += c.get("total", 0)
+        base = name.split("#", 1)[0]  # strip the dedup suffix
+        if ":" not in base:
+            continue
+        seen = True
+        key = base.split(":", 1)[1]
+        row = per.setdefault(
+            key,
+            {"crawl_seconds": 0.0, "levels": 0, "ingest_admitted": 0,
+             "data_bytes": 0},
+        )
+        phases = snap.get("phases", {})
+        for ph in ("fss", "gc_ot", "field"):
+            t = phases.get(ph)
+            if t is not None:
+                row["crawl_seconds"] += t.get("seconds", 0.0)
+                lv = [int(k) for k in t.get("by_level", {})]
+                if lv:
+                    row["levels"] = max(row["levels"], max(lv) + 1)
+        for cname in ("pool_admitted_keys", "ingest_admitted"):
+            c = counters.get(cname)
+            if c is not None:
+                row["ingest_admitted"] += c.get("total", 0)
+        for cname in ("data_bytes_sent", "data_bytes_recv"):
+            c = counters.get(cname)
+            if c is not None:
+                row["data_bytes"] += c.get("total", 0)
+    if not seen:
+        return None
+    for row in per.values():
+        row["crawl_seconds"] = round(row["crawl_seconds"], 6)
+    return {
+        "count": len(per),
+        "device_turns": turns,
+        "stall_fills": fills,
+        "fill_ratio": round(fills / max(1, turns), 6),
+        "per_session": dict(sorted(per.items())),
     }
 
 

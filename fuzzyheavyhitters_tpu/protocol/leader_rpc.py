@@ -51,8 +51,14 @@ class RpcLeader:
         self._boot_ids: dict = {}  # last known server boot ids
         self._mesh_faults: dict = {}  # last seen mesh.faults counts
         # leader-side telemetry: level spans (the heartbeat names the
-        # level a wedged crawl died in) + survivor gauges
-        self.obs = obsmetrics.Registry("leader")
+        # level a wedged crawl died in) + survivor gauges.  A leader
+        # driving a non-default collection names it in the registry so
+        # the heartbeat/report show the (session, phase) pair.
+        self.collection = getattr(client0, "collection", None) or "default"
+        self.obs = obsmetrics.Registry(
+            "leader" if self.collection == "default"
+            else f"leader:{self.collection}"
+        )
         # the clients predate this registry (connect() runs first); rebind
         # their control-plane byte accounting so control_bytes_* land on
         # the leader's registry, not the process default
@@ -878,8 +884,13 @@ class WindowedIngest:
         self.window = 0
         self.policy = policy or INGEST_POLICY
         # a dedicated registry so the heartbeat names the ingest phase
-        # (span "ingest" per window) independently of the crawl's spans
-        self.obs = obsmetrics.Registry("ingest")
+        # (span "ingest" per window) independently of the crawl's spans;
+        # a non-default collection is named in the registry so the
+        # report's sessions rollup attributes its ingest counters
+        _coll = getattr(lead, "collection", None) or "default"
+        self.obs = obsmetrics.Registry(
+            "ingest" if _coll == "default" else f"ingest:{_coll}"
+        )
         self._span_ctx = None
         self._journal: dict[int, list] = {}  # window -> submission records
         self._journaled: set = set()  # sub_ids already journaled
@@ -1216,3 +1227,98 @@ class WindowedIngest:
                 replayed += 1
         if replayed:
             self.obs.count("ingest_journal_replays", replayed)
+
+
+# ---------------------------------------------------------------------------
+# Multi-tenant: N concurrent collections against ONE server pair
+# ---------------------------------------------------------------------------
+
+
+class MultiCollectionDriver:
+    """Run N collections CONCURRENTLY against one collector server pair
+    (the multi-tenant driver of ROADMAP items b/c).
+
+    Each job gets its own client pair — the ``collection`` field of the
+    ``__hello__`` handshake binds every connection to its
+    per-collection server session (protocol/sessions.py) — and its own
+    :class:`RpcLeader`, then all jobs run concurrently on one event
+    loop: while tenant A's level waits on the GC/OT wire, tenant B's
+    expand dispatches (the servers' TenantScheduler counts those stall
+    fills).  Results are bit-identical to solo runs of the same keys by
+    construction: independent trees, independent FSS keys, independent
+    OT streams per session — asserted end-to-end in
+    tests/test_sessions.py and gated in ``bench_multitenant``."""
+
+    def __init__(self, cfg: Config, host0: str, port0: int,
+                 host1: str, port1: int, *, min_bucket: int = 1,
+                 budgets: respolicy.VerbBudgets | None = None):
+        self.cfg = cfg
+        self._addr0 = (host0, port0)
+        self._addr1 = (host1, port1)
+        self.min_bucket = min_bucket
+        self.budgets = budgets
+        self.leaders: dict[str, RpcLeader] = {}
+
+    async def open(self, collection: str) -> RpcLeader:
+        """Connect one collection's client pair and build its leader
+        (``reset`` included, so the session starts clean)."""
+        kw = {"collection": collection}
+        if self.budgets is not None:
+            kw["budgets"] = self.budgets
+        c0 = await CollectorClient.connect(*self._addr0, **kw)
+        c1 = await CollectorClient.connect(*self._addr1, **kw)
+        lead = RpcLeader(self.cfg, c0, c1, min_bucket=self.min_bucket)
+        await lead._both("reset")
+        self.leaders[collection] = lead
+        return lead
+
+    async def close(self) -> None:
+        for lead in self.leaders.values():
+            for c in (lead.c0, lead.c1):
+                await c.aclose()
+        self.leaders.clear()
+
+    async def run_collections(self, jobs: list, *, supervised: bool = True,
+                              warmup: bool = False,
+                              checkpoint_every: int = 8) -> dict:
+        """Run every job concurrently; returns {collection: CrawlResult}.
+
+        ``jobs``: list of dicts ``{collection, nreqs, keys0, keys1}``
+        with optional ``sketch0``/``sketch1`` (malicious mode).
+        ``supervised`` routes through :meth:`RpcLeader.run_supervised`
+        (per-tenant checkpoints in the session's own namespace,
+        per-tenant recovery); False runs the bare upload+run path.  A
+        single tenant's failure does not tear the others down — it is
+        reported under its collection key as the raised exception."""
+
+        async def one(job: dict):
+            key = str(job["collection"])
+            lead = self.leaders.get(key) or await self.open(key)
+            if supervised:
+                return await lead.run_supervised(
+                    int(job["nreqs"]), job["keys0"], job["keys1"],
+                    job.get("sketch0"), job.get("sketch1"),
+                    checkpoint_every=checkpoint_every, warmup=warmup,
+                )
+            await lead.upload_keys(
+                job["keys0"], job["keys1"],
+                job.get("sketch0"), job.get("sketch1"),
+            )
+            if warmup:
+                await lead.warmup()
+            return await lead.run(int(job["nreqs"]))
+
+        keys = [str(j["collection"]) for j in jobs]
+        done = await asyncio.gather(
+            *(one(j) for j in jobs), return_exceptions=True
+        )
+        results = dict(zip(keys, done))
+        failed = {k: r for k, r in results.items() if isinstance(r, BaseException)}
+        if failed:
+            obsmod.emit(
+                "tenancy.collections_failed",
+                severity="warn",
+                collections=sorted(failed),
+                errors={k: f"{type(e).__name__}: {e}" for k, e in failed.items()},
+            )
+        return results

@@ -59,19 +59,39 @@ level loop then runs on it while ingest keeps landing in later windows
 ``tree_checkpoint``/``tree_restore`` (entry slots, per-``sub_id``
 verdicts, reservoir RNG state), so a kill mid-window neither loses nor
 double-counts admitted keys.
+
+Multi-tenant collection sessions (ROADMAP "Multi-host, multi-tenant
+collector fleet", items b/c): every piece of per-collection state above
+lives in a keyed :class:`~.sessions.CollectionSession` selected on the
+wire by the ``collection`` field of the existing ``__hello__``
+handshake — one server pair serves N independent collections at once,
+each with its own frontier, sketch ratchet, expand cache, ingest gate
+(token bucket + quotas + pools), replay-dedup namespace, checkpoint
+namespace, OT endpoints, and verb lock.  The server↔server data plane
+demultiplexes into per-collection channels (:class:`~.sessions.PlaneMux`
+— frames are ``(collection, payload)``), so two tenants' 2PC exchanges
+interleave on one socket without desynchronizing; per-session base-OT /
+coin-flip handshakes run lazily over each session's channel.  Device
+work interleaves across sessions through the
+:class:`~.tenancy.TenantScheduler`: while tenant A's span waits on the
+GC/OT wire, tenant B's expand stage takes a device turn (the
+``pipeline_stalls`` gap a second tenant fills — counted as
+``tenant_stall_fills``), and warmup's compiled-program ladder is shared
+process-wide (:mod:`~.tenancy` WarmLadder) so a new collection on a
+warmed shape pays zero fresh compiles.  A connection that never names a
+collection works on the DEFAULT session; every single-tenant flow is
+unchanged, including checkpoint file names.
 """
 
 from __future__ import annotations
 
 import asyncio
 import collections as _collections
-import hashlib
 import os
 import pickle
 import secrets as _secrets
 import struct
 import time
-from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
@@ -79,31 +99,26 @@ import numpy as np
 
 from .. import obs
 from ..obs import metrics as obsmetrics
-from ..ops import baseot, dpf, gc, ibdcf, otext, prg
+from ..ops import baseot, dpf, gc, otext
 from ..ops.fields import F255, FE62
 from ..ops.ibdcf import EvalState, IbDcfKeyBatch
 from ..parallel import kernel_shard, server_mesh as smesh
-from ..resilience import admission as resadmission
 from ..resilience import chaos as reschaos
 from ..resilience import policy as respolicy
 from ..utils import guards
 from ..utils.config import Config
-from . import collect, mpc, secure, sketch as sketchmod
+from . import collect, mpc, secure, sessions, sketch as sketchmod, tenancy
+from .sessions import (  # noqa: F401  (re-exports: wire-format helpers kept importable as rpc.*)
+    DEFAULT_COLLECTION,
+    SHARED_MASK_SEED,
+    CollectionSession,
+    SessionTable,
+    _SKETCH_TREEDEF,
+    mask_f255,
+    mask_fe62,
+)
 
 _HDR = struct.Struct("<Q")
-SHARED_MASK_SEED = b"XXX This is bog\x00"  # 16 B, ref: server.rs:331-332
-
-# structure template for (de)serializing sketch key batches over the wire
-_z = np.zeros(0)
-_SKETCH_TREEDEF = sketchmod.SketchKeyBatch(
-    key=dpf.DpfKeyBatch(_z, _z, _z, _z, _z, _z),
-    mac_key=_z,
-    mac_key2=_z,
-    mac_key_last=_z,
-    mac_key2_last=_z,
-    triples=mpc.TripleBatch(_z, _z, _z),
-    triples_last=mpc.TripleBatch(_z, _z, _z),
-)
 
 
 async def _send(writer: asyncio.StreamWriter, obj, count=None,
@@ -175,29 +190,6 @@ def _start_host_copy(x) -> None:
         pass
 
 
-def _mask_words(level: int, n: int, blocks_for: int) -> np.ndarray:
-    """Shared pseudorandom mask words for one level (both servers derive the
-    same stream, so shares cancel on reconstruction).  Host NumPy on
-    purpose: the mask is tiny (F·2^d elements) and the device version
-    would cost a device->host round trip per level per server — a full
-    tunnel RTT on remote-chip deployments."""
-    seed = prg.seeds_from_bytes(SHARED_MASK_SEED)[0].copy()
-    seed[3] ^= np.uint32(level)
-    return prg.np_stream_words(seed, n * blocks_for).reshape(n, blocks_for)
-
-
-def mask_fe62(level: int, n: int) -> np.ndarray:
-    # host twin of FE62.sample: the device version sampled ~KB of masks on
-    # the accelerator and fetched them back — one tunnel RTT per level for
-    # work NumPy does in microseconds (flagged by fhh-lint
-    # host-sync-in-hot-loop, round 6)
-    return FE62.np_sample(_mask_words(level, n, 4))
-
-
-def mask_f255(level: int, n: int) -> np.ndarray:
-    return F255.np_sample(_mask_words(level, n, 8))
-
-
 # ---------------------------------------------------------------------------
 # Server
 # ---------------------------------------------------------------------------
@@ -235,11 +227,13 @@ class _Session:
     twice either way."""
 
     __slots__ = (
-        "epoch", "cache", "sizes", "bytes_total", "inflight", "last_seen"
+        "epoch", "cache", "sizes", "bytes_total", "inflight", "last_seen",
+        "collection",
     )
 
     def __init__(self):
         self.epoch = 0
+        self.collection = DEFAULT_COLLECTION  # bound at __hello__
         self.cache: _collections.OrderedDict = _collections.OrderedDict()
         self.sizes: dict[int, int] = {}
         self.bytes_total = 0
@@ -262,405 +256,202 @@ class _Session:
             self.bytes_total -= self.sizes.pop(old, 0)
 
 
-class _WindowPool:
-    """One ingest window's append-only key pool (the streaming front
-    door's unit of work: protocol verbs ``submit_keys`` → ``window_seal``
-    → ``window_load``).
-
-    ``entries`` holds admitted submissions (tuples of key arrays, the
-    same chunk shape ``add_keys`` receives) in arrival order; once the
-    reservoir shed policy engages, the list freezes into a SLOT TABLE
-    and replacements overwrite in place.  ``verdicts`` records every
-    FINAL outcome by ``sub_id`` so at-least-once delivery (reconnect
-    replays, recovery journal replays) answers the recorded verdict
-    instead of double-admitting or re-advancing the sampler's RNG.
-    Overloaded rejections are deliberately NOT recorded — a backed-off
-    retry is a fresh attempt against refilled tokens."""
-
-    __slots__ = (
-        "window", "wa", "entries", "verdicts", "keys",
-        "admitted_keys", "shed_keys", "rejected", "sealed",
-    )
-
-    def __init__(self, window: int, wa: resadmission.WindowAdmission):
-        self.window = int(window)
-        self.wa = wa
-        self.entries: list = []
-        self.verdicts: dict = {}
-        self.keys = 0
-        self.admitted_keys = 0
-        self.shed_keys = 0
-        self.rejected = 0
-        self.sealed = False
-
-    def apply(self, sub_id: str, chunk: tuple,
-              v: resadmission.Verdict) -> dict:
-        """Commit one gate verdict to the pool; returns the wire
-        response (the mirror server replays it via :meth:`apply_mirror`)."""
-        n_keys = int(chunk[0].shape[0])
-        if not v.admitted and v.scope is not None:
-            self.rejected += 1
-            return {
-                "admitted": False, "overloaded": True, "scope": v.scope,
-                "retry_after_s": round(float(v.retry_after_s), 4),
-                "window": self.window,
-            }
-        if not v.admitted:  # reservoir shed this submission
-            resp = {"admitted": False, "shed": True, "window": self.window}
-            self.verdicts[sub_id] = resp
-            self.shed_keys += n_keys
-            return resp
-        if v.slot is None:
-            self.entries.append(chunk)
-            self.keys += n_keys
-        else:
-            old = self.entries[v.slot]
-            old_n = int(old[0].shape[0])
-            self.entries[v.slot] = chunk
-            self.keys += n_keys - old_n
-            self.shed_keys += old_n
-            # keep the admission ledger's occupancy honest under
-            # variable-size chunks
-            self.wa.keys += n_keys - old_n
-        self.admitted_keys += n_keys
-        resp = {"admitted": True, "slot": v.slot, "window": self.window}
-        self.verdicts[sub_id] = resp
-        return resp
-
-    def apply_mirror(self, sub_id: str, chunk: tuple, mirror: dict,
-                     client_id: str | None = None) -> dict:
-        """Replay the GATE server's verdict on the peer pool so both
-        servers' windows stay positionally identical.  Validates loudly —
-        a mirror that cannot apply means the two pools diverged, which
-        must never be papered over."""
-        n_keys = int(chunk[0].shape[0])
-        slot = mirror.get("slot")
-        if self.wa.shed == resadmission.SHED_RESERVOIR:
-            if self.wa.sub_keys is None:
-                self.wa.sub_keys = n_keys  # uniform-chunk contract holds
-            if mirror.get("shed") or slot is not None:
-                # a restored GATE being rebuilt by the recovery journal:
-                # the replayed verdict consumed one sampler draw in its
-                # first life — advance the restored stream past it (the
-                # verdict itself is applied verbatim below), so
-                # post-recovery live admissions continue the SAME
-                # seed-reproducible sequence.  When the reservoir
-                # engaged only AFTER the last checkpoint, there is no
-                # sampler to advance yet: bank the draw so the eventual
-                # engagement fast-forwards past it.  A mirror server
-                # never re-engages a reservoir, so this is harmless
-                # bookkeeping outside recovery.
-                if self.wa.reservoir is not None:
-                    self.wa.reservoir.offer(1)
-                else:
-                    self.wa.pending_draws += 1
-        if mirror.get("shed"):
-            resp = {"admitted": False, "shed": True, "window": self.window}
-            self.verdicts[sub_id] = resp
-            self.shed_keys += n_keys
-            return resp
-        if slot is None:
-            if self.keys + n_keys > self.wa.max_keys:
-                raise RuntimeError(
-                    f"ingest mirror overflows window {self.window}: "
-                    f"{self.keys} + {n_keys} > {self.wa.max_keys} "
-                    "(gate/mirror pools diverged)"
-                )
-            self.entries.append(chunk)
-            self.keys += n_keys
-            # keep the admission ledger in lockstep: a recovery journal
-            # replay rebuilds a restarted GATE through this path, and its
-            # later live decisions must see the true occupancy
-            self.wa.subs += 1
-            self.wa.keys += n_keys
-            self.wa._charge(client_id, n_keys)
-        else:
-            slot = int(slot)
-            if not 0 <= slot < len(self.entries):
-                raise RuntimeError(
-                    f"ingest mirror names slot {slot} of a "
-                    f"{len(self.entries)}-slot window {self.window} pool "
-                    "(gate/mirror pools diverged)"
-                )
-            old_n = int(self.entries[slot][0].shape[0])
-            self.entries[slot] = chunk
-            self.keys += n_keys - old_n
-            self.shed_keys += old_n
-            self.wa.keys += n_keys - old_n
-            self.wa._charge(client_id, n_keys)
-        self.admitted_keys += n_keys
-        resp = {"admitted": True, "slot": slot, "window": self.window}
-        self.verdicts[sub_id] = resp
-        return resp
-
-    def stats(self) -> dict:
-        return {
-            "window": self.window,
-            "sealed": self.sealed,
-            "keys": self.keys,
-            "subs": len(self.entries),
-            "admitted_keys": self.admitted_keys,
-            "shed_keys": self.shed_keys,
-            "rejected": self.rejected,
-        }
-
-
 # Runtime twin of the fhh-race guard map — the "CollectorServer.*"
-# entries of pyproject [tool.fhh-lint.guards], attr -> owning asyncio
-# lock (drift-tested against the pyproject table in
-# tests/test_concurrency.py).  Under FHH_DEBUG_GUARDS=1 (or
-# Config.debug_guards) utils/guards.py arms a GuardedState descriptor
-# per entry, so every access asserts the lock is held by the current
-# task — the dynamic validation of the `# fhh-race: holds=` contracts
-# the static analyzer cannot see through _dispatch's dynamic getattr.
+# entries of pyproject [tool.fhh-lint.guards] (server-INFRA state; the
+# per-collection state moved to sessions.CollectionSession and its
+# _SESSION_GUARDS twin).  Drift-tested in tests/test_concurrency.py.
 _SERVER_GUARDS = {
-    "frontier": "_verb_lock",
-    "keys": "_verb_lock",
-    "keys_parts": "_verb_lock",
-    "alive_keys": "_verb_lock",
-    "_expand_ready": "_verb_lock",
-    "_ingest_pools": "_verb_lock",
-    "_admission": "_verb_lock",
     "_sessions": "_verb_lock",
-    "_sketch_parts": "_verb_lock",
-    "_sketch_root": "_verb_lock",
-    "_ratchet_digest": "_verb_lock",
 }
 
 
-@dataclass
 class CollectorServer:
     """One collector server process (ref: server.rs:44-172).
 
     ``server_id`` 0 dials the peer, 1 listens (ref: server.rs:208-233).
+
+    Multi-tenant: the server itself holds only SHARED infrastructure —
+    the control listeners, the peer data plane (demultiplexed per
+    collection by :class:`~.sessions.PlaneMux`), the replay-dedup
+    session table, the tenant scheduler, and the boot id.  Everything a
+    single collection owns lives in its
+    :class:`~.sessions.CollectionSession` (``self._table``), resolved
+    per connection from the ``__hello__`` handshake; the attribute
+    properties below delegate to the DEFAULT session so single-tenant
+    callers (and the existing tests) see the exact pre-session surface.
     """
 
-    server_id: int
-    cfg: Config
-    keys_parts: list = field(default_factory=list)
-    keys: IbDcfKeyBatch | None = None
-    alive_keys: np.ndarray | None = None
-    frontier: collect.Frontier | None = None
-    _children: object | None = None  # expand-time child-state cache
-    _peer_reader: asyncio.StreamReader | None = None
-    _peer_writer: asyncio.StreamWriter | None = None
-    _ot: object | None = None  # secure-plane marker (both endpoints below)
-    _ot_snd: object | None = None  # extension sender (levels this side garbles)
-    _ot_rcv: object | None = None  # extension receiver (levels it evaluates)
-    _sec_seed: np.ndarray | None = None  # session seed for GC/b2a randomness
-    _crawl_ctr: int = 0  # makes per-crawl garbling randomness unique
-    _last_shares: np.ndarray | None = None  # last-level leaf count shares
-    # mid-level sharding: per-shard child caches / leaf shares keyed by
-    # span lo, assembled at prune time (collect.children_cat); a shard
-    # re-run simply overwrites its slot
-    _shard_children: dict = field(default_factory=dict)
-    _shard_last: dict = field(default_factory=dict)
-    _shard_level: int | None = None
-    _mask_cache: tuple | None = None  # ((level, F, f255), full-level rows)
-    # pipelined-crawl expand stage: device work dispatched at FRAME
-    # ARRIVAL (before the verb lock) keyed by (kind, level, span), so
-    # span k+1's FSS expansion runs while span k's open stage is on the
-    # data plane.  Entries are pure functions of (keys, frontier, level,
-    # span) — reuse across a shard re-run is bit-identical — and every
-    # frontier mutation (prune/restore/init/reset) clears the dict.
-    _expand_ready: dict = field(default_factory=dict)
-    _sketch_parts: list = field(default_factory=list)
-    _sketch: object | None = None  # SketchKeyBatch (malicious-secure mode)
-    _sketch_states: object | None = None  # DpfEvalState [F, N, d], frontier-following
-    _sketch_pids: np.ndarray | None = None  # int32[F, d] per-dim prefix ids
-    _sketch_depth: int = 0  # how far the sketch frontier has advanced
-    _sketch_pairs: tuple | None = None  # (pair shares [F, N, d, lanes], depth)
-    _sketch_pairs_field: object | None = None
-    _sketch_seed: np.ndarray | None = None  # coin-flipped session seed
-    # challenge ratchet (sketch.py): the root seed committed at tree_init
-    # and the boot-independent transcript digest — together they derive
-    # each level's challenge, so a recovered level replays the IDENTICAL
-    # challenge instead of re-opening triples under fresh randomness
-    _sketch_root: np.ndarray | None = None
-    _ratchet_digest: bytes | None = None
-    # telemetry: phase timers (the reference's 3-phase level taxonomy,
-    # collect.rs:412-503, as "fss"/"gc_ot"/"field"), data-plane byte and
-    # device-fetch accounting, gc_tests — all per level (obs/report.py
-    # names the full schema).  One registry PER server: the bench and the
-    # tests run both servers in one process and the run report asserts
-    # their accounting consistent against each other.
-    obs: obsmetrics.Registry | None = None
-    _verb_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
-    # resilience state: where tree_checkpoint persists crawl state (None
-    # disables the verb), the boot id that lets a reconnecting leader
-    # distinguish "same process, blipped network" from "restarted, state
-    # gone", per-leader-session replay dedup, and the peer address kept
-    # for plane_reset redials
-    ckpt_dir: str | None = None
-    _boot_id: str = field(default_factory=lambda: _secrets.token_hex(8))
-    _sessions: dict = field(default_factory=dict)
-    _peer_addr: tuple | None = None
-    _ctl_writers: set = field(default_factory=set)
-    # streaming ingest front door: bounded per-window key pools
-    # (submit_keys → window_seal → window_load) and the admission gate
-    # (resilience/admission.py) deciding admit/shed/Overloaded; tests
-    # may swap _admission for one with a manual clock
-    _ingest_pools: dict = field(default_factory=dict)
-    _admission: object | None = None
-    # multi-chip client sharding (parallel/server_mesh.py): the local
-    # pjit mesh the client axis shards over, and an optional injected
-    # device-loss schedule (resilience.chaos.MeshChaos — reused from the
-    # 2-D mesh path; tests and bin/server wire FHH_MESH_FAULTS here)
-    _mesh: object | None = None
-    _mesh_chaos: object | None = None
-
-    def __post_init__(self):
-        if self.obs is None:
-            self.obs = obsmetrics.Registry(f"server{self.server_id}")
-        if self._mesh is None:
-            k = smesh.resolve_data_devices(self.cfg.server_data_devices)
-            if k > 1:
-                self._mesh = smesh.ServerMesh(k)
-        if self._admission is None:
-            self._admission = resadmission.AdmissionController(
-                max_window_keys=self.cfg.ingest_window_keys,
-                rate_keys_per_s=self.cfg.ingest_rate_keys_per_s,
-                burst_keys=self.cfg.ingest_burst_keys,
-                client_quota=self.cfg.ingest_client_quota,
-                shed=self.cfg.ingest_shed,
-                seed=self.cfg.ingest_seed,
-            )
+    def __init__(self, server_id: int, cfg: Config, *,
+                 obs: obsmetrics.Registry | None = None,
+                 ckpt_dir: str | None = None,
+                 _mesh_chaos: object | None = None):
+        self.server_id = server_id
+        self.cfg = cfg
+        self.ckpt_dir = ckpt_dir
+        # telemetry: ONE registry per server for shared-plane accounting
+        # (control bytes, replay dedup, plane resets, tenant scheduler);
+        # the DEFAULT collection session shares it — so single-tenant
+        # runs report exactly as before — and every other session gets
+        # its own "server{id}:{collection}" registry (the heartbeat then
+        # names the active (session, phase) pair).
+        self.obs = obs if obs is not None else obsmetrics.Registry(
+            f"server{self.server_id}"
+        )
+        # per-collection sessions, keyed on the wire (__hello__)
+        self._table = SessionTable(server_id, cfg, self.obs, ckpt_dir)
+        # device-work interleaving across sessions + stall-fill telemetry
+        self._sched = tenancy.TenantScheduler(self.obs)
+        # peer data plane: one socket, demuxed per collection; sends are
+        # (collection, payload) frames, the pump routes receives
+        self._peer_reader: asyncio.StreamReader | None = None
+        self._peer_writer: asyncio.StreamWriter | None = None
+        self._plane = sessions.PlaneMux(route_count=self._plane_count)
+        self._peer_addr: tuple | None = None
+        # resilience state: boot id (reconnect vs restart), per-leader-
+        # session replay dedup, control writers for aclose
+        self._boot_id: str = _secrets.token_hex(8)
+        self._sessions: dict = {}
+        self._ctl_writers: set = set()
+        # injected device-loss schedule (resilience.chaos.MeshChaos —
+        # tests and bin/server wire FHH_MESH_FAULTS here); fires against
+        # whichever session's crawl reaches the scheduled level first
+        self._mesh_chaos = _mesh_chaos
+        # server-infra lock: guards the replay-session table (and
+        # serializes plane_reset); per-collection verbs serialize on
+        # their session's OWN _verb_lock instead
+        self._verb_lock = asyncio.Lock()
         # LAST: the sanitizer (a no-op unless FHH_DEBUG_GUARDS=1 or
         # cfg.debug_guards) wraps the already-constructed guarded state
         guards.install(self, _SERVER_GUARDS, force=self.cfg.debug_guards)
 
+    # -- default-session delegation (single-tenant compat surface) --------
+    #
+    # The pre-session CollectorServer exposed its per-collection state as
+    # plain attributes; tests, chaos harnesses, and operator tooling
+    # read (and occasionally write) them.  Each property below is a
+    # straight view onto the DEFAULT session's attribute.
+
+    def _default(self) -> CollectionSession:
+        return self._table.default()
+
+    @property
+    def _mesh(self):
+        return self._default()._mesh
+
+    def _mk_state_prop(name):  # noqa: N805  (class-body helper, deleted below)
+        def _get(self):
+            return getattr(self._default(), name)
+
+        def _set(self, value):
+            setattr(self._default(), name, value)
+
+        return property(_get, _set)
+
+    keys = _mk_state_prop("keys")
+    keys_parts = _mk_state_prop("keys_parts")
+    alive_keys = _mk_state_prop("alive_keys")
+    frontier = _mk_state_prop("frontier")
+    _children = _mk_state_prop("_children")
+    _last_shares = _mk_state_prop("_last_shares")
+    _expand_ready = _mk_state_prop("_expand_ready")
+    _ingest_pools = _mk_state_prop("_ingest_pools")
+    _admission = _mk_state_prop("_admission")
+    _sketch = _mk_state_prop("_sketch")
+    _sketch_seed = _mk_state_prop("_sketch_seed")
+    _sketch_root = _mk_state_prop("_sketch_root")
+    _ratchet_digest = _mk_state_prop("_ratchet_digest")
+    _ot = _mk_state_prop("_ot")
+    _ot_snd = _mk_state_prop("_ot_snd")
+    _ot_rcv = _mk_state_prop("_ot_rcv")
+    _sec_seed = _mk_state_prop("_sec_seed")
+    del _mk_state_prop
+
+    # checkpoint-namespace helpers, default-session view (tests/tooling)
+    def _ckpt_path(self, level: int) -> str:
+        return self._default().ckpt_path(level)
+
+    def _ckpt_levels(self) -> list:
+        return self._default().ckpt_levels()
+
+    def _ckpt_prune(self, keep: int = 2) -> None:
+        self._default().ckpt_prune(keep)
+
+    def _ckpt_clear(self) -> None:
+        self._default().ckpt_clear()
+
     # -- verbs (ref: rpc.rs:56-66) ---------------------------------------
 
-    async def reset(self, _req) -> bool:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
-        self.keys_parts.clear()
-        self.keys = None
-        self.alive_keys = None
-        self.frontier = None
-        self._children = None
-        self._last_shares = None
-        self._shard_children.clear()
-        self._shard_last.clear()
-        self._shard_level = None
-        self._expand_ready.clear()
-        self._sketch_parts.clear()
-        self._sketch = None
-        self._sketch_states = None
-        self._sketch_pids = None
-        self._sketch_depth = 0
-        self._sketch_pairs = None
-        self._sketch_pairs_field = None
-        self._sketch_root = None
-        self._ratchet_digest = None
-        self._ingest_pools.clear()  # a new collection's front door opens clean
-        self._ckpt_clear()  # a new collection must not resume an old one's
-        self.obs.reset()  # fresh per-collection phase/byte/fetch accounting
-        if self._ot is not None:  # fresh GC/b2a randomness per collection
-            self._sec_seed = np.frombuffer(
-                _secrets.token_bytes(16), dtype="<u4"
-            ).copy()
+    async def reset(self, _req, cs: CollectionSession | None = None) -> bool:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the session's verb lock; sanitizer-validated)
+        cs = cs if cs is not None else self._default()
+        # the DEFAULT session shares the SERVER registry: when other
+        # tenants are live, its reset must not zero their shared-plane
+        # accounting (scheduler fills, dedup hits, control bytes) —
+        # per-session registries always wipe
+        cs.reset_state(
+            reset_obs=(
+                cs.key != DEFAULT_COLLECTION or len(self._table) <= 1
+            )
+        )
         return True
 
-    async def add_keys(self, req) -> bool:  # fhh-race: atomic (unlocked upload fast path: append-only, never suspends — many in-flight batches deserialize concurrently by design)
+    async def add_keys(self, req, cs: CollectionSession | None = None) -> bool:  # fhh-race: atomic (unlocked upload fast path: append-only, never suspends — many in-flight batches deserialize concurrently by design)
         """req: pytree-of-arrays key batch chunk [B, d, 2] (the tensor form
         of AddKeysRequest, ref: rpc.rs:13-15).  An optional ``sketch`` entry
         carries the clients' malicious-security material (MAC'd payload
         DPFs + triples, protocol/sketch.py)."""
-        self.keys_parts.append(IbDcfKeyBatch(*req["keys"]))
+        cs = cs if cs is not None else self._default()
+        cs.keys_parts.append(IbDcfKeyBatch(*req["keys"]))
         if req.get("sketch") is not None:
-            self._sketch_parts.append(
+            cs._sketch_parts.append(
                 jax.tree.unflatten(
                     jax.tree.structure(_SKETCH_TREEDEF), req["sketch"]
                 )
             )
         return True
 
-    def _planar(self) -> bool:
-        """This server's frontier LAYOUT: the process expand engine,
-        except under the multi-chip mesh, which pins interleaved/XLA
-        (the client axis must be a plain named axis — pallas_call takes
-        no sharded operands; same pin as the 2-D mesh bodies)."""
-        return collect._expand_engine() and self._mesh is None
-
-    def _concat_keys(self) -> None:
-        """Materialize ``self.keys`` from the uploaded chunks (shared by
-        ``tree_init`` and ``tree_restore`` — a restored server re-receives
-        its key chunks but must NOT re-root its frontier).  Under the
-        multi-chip mesh the batch binds the active shard count and the
-        key planes land client-axis-sharded across the local devices."""
-        self.keys = IbDcfKeyBatch(
-            *[
-                # fhh-lint: disable=chunked-device-readback,host-sync-in-hot-loop (wire input: the uploaded chunks are host numpy already — np.asarray is a no-copy view; runs once per collection/restore, never per level)
-                np.concatenate([np.asarray(p[i]) for p in self.keys_parts])
-                for i in range(len(self.keys_parts[0]))
-            ]
-        )
-        if self._mesh is not None:
-            self._mesh.bind(self.keys.cw_seed.shape[0])
-            self.keys = self._mesh.shard_keys(self.keys)
-
-    async def tree_init(self, req) -> bool:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
-        if not self.keys_parts:
+    async def tree_init(self, req, cs: CollectionSession | None = None) -> bool:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the session's verb lock; sanitizer-validated)
+        cs = cs if cs is not None else self._default()
+        if not cs.keys_parts:
             raise RuntimeError("tree_init before add_keys")
+        # the session's data-plane channel must be keyed (coin flip +
+        # base-OT) before the ratchet root commits or any level crawls
+        await self._ensure_session_plane(cs)
         root_bucket = int((req or {}).get("root_bucket", 1))
-        self._concat_keys()
-        n = self.keys.cw_seed.shape[0]
-        self.alive_keys = np.ones(n, bool)
-        if self._mesh is not None:
-            self.frontier = self._mesh.shard_frontier(
-                collect.tree_init(self.keys, root_bucket, planar=False)
+        cs.concat_keys()
+        n = cs.keys.cw_seed.shape[0]
+        cs.alive_keys = np.ones(n, bool)
+        if cs._mesh is not None:
+            cs.frontier = cs._mesh.shard_frontier(
+                collect.tree_init(cs.keys, root_bucket, planar=False)
             )
         else:
-            self.frontier = collect.tree_init(self.keys, root_bucket)
-        self._children = None
-        self._shard_children.clear()
-        self._shard_last.clear()
-        self._shard_level = None
-        self._expand_ready.clear()
-        if self._sketch_parts:
-            self._concat_sketch()
-            root = dpf.eval_init(self._sketch.key)  # [N, d]
-            self._sketch_states = jax.tree.map(
+            cs.frontier = collect.tree_init(cs.keys, root_bucket)
+        cs._children = None
+        cs._shard_children.clear()
+        cs._shard_last.clear()
+        cs._shard_level = None
+        cs._expand_ready.clear()
+        if cs._sketch_parts:
+            cs.concat_sketch()
+            root = dpf.eval_init(cs._sketch.key)  # [N, d]
+            cs._sketch_states = jax.tree.map(
                 lambda a: jnp.broadcast_to(a[None], (1,) + a.shape), root
             )
-            self._sketch_pids = np.zeros(
-                (1, self._sketch.key.root_seed.shape[1]), np.int32
+            cs._sketch_pids = np.zeros(
+                (1, cs._sketch.key.root_seed.shape[1]), np.int32
             )
-            self._sketch_depth = 0
-            self._sketch_pairs = None
+            cs._sketch_depth = 0
+            cs._sketch_pairs = None
             # commit the challenge ratchet: root = the coin flip of the
             # CURRENT data-plane session (unpredictable to clients, who
             # committed their keys before this point), transcript = empty.
             # Both are checkpointed with the frontier, so later plane
             # resets / restarts cannot perturb any level's challenge.
-            self._sketch_root = np.asarray(self._sketch_seed, np.uint32).copy()
-            self._ratchet_digest = sketchmod.transcript_init()
+            cs._sketch_root = np.asarray(cs._sketch_seed, np.uint32).copy()
+            cs._ratchet_digest = sketchmod.transcript_init()
         return True
 
-    def _concat_sketch(self) -> None:
-        """Materialize ``self._sketch`` from the uploaded chunks (shared
-        by ``tree_init`` and the sketch ``tree_restore`` path — a restored
-        server re-receives its sketch chunks but must NOT re-root its
-        frontier-following states)."""
-        leaves = [jax.tree.leaves(p) for p in self._sketch_parts]
-        # fhh-lint: disable=chunked-device-readback,host-sync-in-hot-loop (wire input: uploaded sketch chunks are host numpy; once per collection/restore)
-        cat = [np.concatenate([np.asarray(p[i]) for p in leaves])
-               for i in range(len(leaves[0]))]
-        self._sketch = jax.tree.unflatten(
-            jax.tree.structure(_SKETCH_TREEDEF), cat
-        )
-
-    def _challenge_seed(self, level: int) -> np.ndarray:
-        """This level's sketch challenge via the ratchet (sketch.py):
-        hash(committed root ‖ level ‖ transcript digest).  Falls back to
-        the raw session seed only when the ratchet was never committed
-        (sketch keys without tree_init — a protocol error soon anyway)."""
-        if self._sketch_root is None:
-            return self._sketch_seed
-        return sketchmod.ratchet_seed(
-            self._sketch_root, level, self._ratchet_digest
-        )
-
-    async def sketch_verify(self, req) -> np.ndarray:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
+    async def sketch_verify(self, req, cs: CollectionSession | None = None) -> np.ndarray:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the session's verb lock; sanitizer-validated)
         """Malicious-security check (ref intent: the TreeSketchFrontier*
         verb vestiges rpc.rs:40-51, gate at collect.rs:495): sketch inner
         products + Beaver verification over the peer data plane, per
@@ -684,14 +475,16 @@ class CollectorServer:
         replays the identical challenge instead of re-opening its Beaver
         triple slab under fresh randomness (which would leak
         ``<r - r', x>``)."""
-        if self._sketch is None:
+        cs = cs if cs is not None else self._default()
+        if cs._sketch is None:
             raise RuntimeError("sketch_verify without sketch keys")
+        await self._ensure_session_plane(cs)
         level = int(req["level"])
-        k = self._sketch.key
+        k = cs._sketch.key
         L = k.data_len
         n, d = k.root_seed.shape[0], k.root_seed.shape[1]
         if level == 0:
-            if self._sketch_depth != 0:
+            if cs._sketch_depth != 0:
                 # the root check must run before the first prune: the
                 # frontier-following states have advanced past the root,
                 # so a late call would verify garbage and corrupt honest
@@ -702,7 +495,7 @@ class CollectorServer:
             # full-width depth-1 check: both children of the root per dim
             last = L == 1
             fld = F255 if last else FE62
-            st = jax.tree.map(lambda a: a[0], self._sketch_states)  # [N, d]
+            st = jax.tree.map(lambda a: a[0], cs._sketch_states)  # [N, d]
             cw = dpf.level_cw(k, 0)
             cwv = k.cw_val[..., 0, :] if not last else k.cw_val_last
             sides = []
@@ -731,13 +524,13 @@ class CollectorServer:
                     "depth 1 is covered by the level-0 full check; "
                     "re-verifying it would re-open its Beaver triples"
                 )
-            if self._sketch_pairs is None or self._sketch_pairs[1] != level:
+            if cs._sketch_pairs is None or cs._sketch_pairs[1] != level:
                 raise RuntimeError(f"no stored sketch shares for depth {level}")
-            pairs_fn, _ = self._sketch_pairs  # [F, N, d, LANES(, limbs)]
-            fld = self._sketch_pairs_field
+            pairs_fn, _ = cs._sketch_pairs  # [F, N, d, LANES(, limbs)]
+            fld = cs._sketch_pairs_field
             last = fld is F255
             m_nodes, dpf_level = pairs_fn.shape[0], level - 1
-        challenge = self._challenge_seed(level)
+        challenge = cs.challenge_seed(level)
         bs = max(
             1,
             self.cfg.sketch_batch_size_last if last else self.cfg.sketch_batch_size,
@@ -745,7 +538,7 @@ class CollectorServer:
         ok_parts = []  # per-batch device verdicts; ONE fetch after the loop
         for lo in range(0, n, bs):
             sl = slice(lo, min(lo + bs, n))
-            ks = jax.tree.map(lambda a: a[sl], self._sketch)
+            ks = jax.tree.map(lambda a: a[sl], cs._sketch)
             n_sl = min(lo + bs, n) - lo
             r, rands = sketchmod.shared_r_stream(
                 fld, challenge, level, m_nodes, n_sl * d
@@ -765,16 +558,19 @@ class CollectorServer:
             # one stacked array = one device fetch + one wire message
             # fhh-lint: disable=host-sync-in-hot-loop,chunked-device-readback (wire fetch: the
             # exchange below needs host bytes; one fetch per round trip)
-            cs = np.asarray(jnp.stack(mpc.cor_share(fld, state)))
-            peer_cs = await self._swap(cs)
-            pair_cs = (cs, peer_cs) if self.server_id == 0 else (peer_cs, cs)
+            cshare = np.asarray(jnp.stack(mpc.cor_share(fld, state)))
+            peer_cs = await self._swap(cs, cshare)
+            pair_cs = (
+                (cshare, peer_cs) if self.server_id == 0
+                else (peer_cs, cshare)
+            )
             opened = mpc.cor(fld, (pair_cs[0][0], pair_cs[0][1]),
                              (pair_cs[1][0], pair_cs[1][1]))
             # fhh-lint: disable=host-sync-in-hot-loop,chunked-device-readback (wire fetch, as above)
             o = np.asarray(
                 mpc.out_share(fld, bool(self.server_id), state, opened)
             )
-            peer_o = await self._swap(o)
+            peer_o = await self._swap(cs, o)
             # verdicts stay ON DEVICE inside the loop; fetching per batch
             # cost one round trip per `bs` clients (fhh-lint caught it)
             ok_parts.append(mpc.verify(fld, o, peer_o))  # [n_sl, d]
@@ -792,84 +588,49 @@ class CollectorServer:
             # (same root, same transcript) — a replay, not a second
             # opening.  The level-0 path has no stored pairs and re-runs
             # under the same identical-challenge argument.
-            self._sketch_pairs = None
-        self.alive_keys &= ok
-        return self.alive_keys.copy()
-
-    def _advance_sketch(self, level: int, parent: np.ndarray, pat_bits: np.ndarray, n_alive: int):
-        """Advance the frontier-following sketch DPF states with the same
-        survivor table as the count frontier (one 1-D sketch tree per
-        dimension; dim j's direction is pattern bit j), storing the new
-        depth's value-pair shares gated by node liveness AND per-dim
-        prefix DEDUPLICATION: in d > 1 the count frontier is a product —
-        two frontier nodes routinely share the same dim-j prefix, and
-        counting an honest one-hot entry twice makes ``<r,x>² != <r²,x>``
-        (with r_i + r_j in place of a single r).  Each dim keeps only the
-        FIRST slot of every distinct prefix; the dedup table derives from
-        the public survivor table, so both servers gate identically."""
-        L = self.keys.cw_seed.shape[-2]
-        last = level == L - 1
-        fld = F255 if last else FE62
-        k = self._sketch.key  # batch [N, d]
-        d = k.root_seed.shape[1]
-        # fhh-lint: disable=host-sync-in-hot-loop (wire input: host numpy)
-        parent = np.asarray(parent)
-        st = jax.tree.map(lambda a: a[parent], self._sketch_states)
-        direction = jnp.asarray(pat_bits, bool)[:, None, :]  # [F, 1, d]
-        cw = tuple(a[None] for a in dpf.level_cw(k, level))  # [1, N, d, ...]
-        cwv = (k.cw_val[..., level, :] if not last else k.cw_val_last)[None]
-        new_st, pair = dpf.eval_bit(
-            cw, st, direction, cwv, k.key_idx[None], fld, sketchmod.LANES
-        )  # pair [F, N, d, LANES(, limbs)]
-        F2 = parent.shape[0]
-        pids = np.zeros((F2, d), np.int32)
-        keep = np.zeros((F2, d), bool)
-        parent_pid = self._sketch_pids[parent[:n_alive]]  # [n_alive, d]
-        for j in range(d):
-            key_j = np.stack(
-                [parent_pid[:, j], pat_bits[:n_alive, j].astype(np.int32)], 1
-            )
-            _, inv = np.unique(key_j, axis=0, return_inverse=True)
-            pids[:n_alive, j] = inv
-            _, first = np.unique(inv, return_index=True)
-            keep[first, j] = True
-        gate = jnp.asarray(
-            keep.reshape((F2, 1, d) + (1,) * (pair.ndim - 3))
-        )
-        pair = jnp.where(gate, pair, 0)
-        self._sketch_states = new_st
-        self._sketch_pids = pids
-        self._sketch_depth = level + 1
-        self._sketch_pairs = (pair, level + 1)
-        self._sketch_pairs_field = fld
+            cs._sketch_pairs = None
+        cs.alive_keys &= ok
+        return cs.alive_keys.copy()
 
     # data-plane framing with byte/message accounting; levels attribute
-    # via the active span (obs.metrics.Registry.count)
-    async def _dp_send(self, obj):
-        self.obs.count("data_msgs_sent")
+    # via the active span (obs.metrics.Registry.count).  Every frame is
+    # (collection, payload): sends interleave freely across sessions
+    # (one atomic write per frame), receives demux through the PlaneMux
+    # so each session reads only its own FIFO channel.
+    def _plane_count(self, chan: str, nbytes: int) -> None:
+        """PlaneMux byte-accounting hook: received bytes land on the
+        owning session's registry (whose active span attributes them to
+        the level being exchanged), unknown channels on the server's."""
+        cs = self._table.peek(chan)
+        reg = cs.obs if cs is not None else self.obs
+        reg.count("data_bytes_recv", nbytes)
+
+    async def _dp_send(self, cs: CollectionSession, obj):
+        cs.obs.count("data_msgs_sent")
         await _send(
-            self._peer_writer, obj,
-            count=lambda n: self.obs.count("data_bytes_sent", n),
+            self._peer_writer, (cs.key, obj),
+            count=lambda n: cs.obs.count("data_bytes_sent", n),
         )
 
-    async def _dp_recv(self):
-        return await _recv(
-            self._peer_reader,
-            count=lambda n: self.obs.count("data_bytes_recv", n),
-        )
+    async def _dp_recv(self, cs: CollectionSession):
+        # wire waits are what a SECOND tenant's device work can fill:
+        # mark them so the scheduler's stall-fill accounting sees the gap
+        with self._sched.wire_wait(cs.key):
+            return await self._plane.recv(cs.key)
 
-    async def _swap(self, obj):
-        """Role-ordered data-plane exchange: server 0 writes first, server 1
-        reads first — symmetric send-then-recv deadlocks once payloads
-        exceed the combined socket buffers (both drains stall)."""
+    async def _swap(self, cs: CollectionSession, obj):
+        """Role-ordered data-plane exchange on this session's channel:
+        server 0 writes first, server 1 reads first — symmetric
+        send-then-recv deadlocks once payloads exceed the combined
+        socket buffers (both drains stall)."""
         if self.server_id == 0:
-            await self._dp_send(obj)
-            return await self._dp_recv()
-        peer = await self._dp_recv()
-        await self._dp_send(obj)
+            await self._dp_send(cs, obj)
+            return await self._dp_recv(cs)
+        peer = await self._dp_recv(cs)
+        await self._dp_send(cs, obj)
         return peer
 
-    def _emit_level_phases(self, level: int, fss, gc_ot, field) -> None:
+    def _emit_level_phases(self, cs, level: int, fss, gc_ot, field) -> None:
         """Per-level phase line (the successor of the old three prints):
         structured, severity=debug so a 512-level crawl doesn't spam the
         console, totals always available in the run report.  Takes the
@@ -879,38 +640,13 @@ class CollectorServer:
             "level.phases",
             severity="debug",
             server=self.server_id,
+            collection=cs.key,
             level=level,
             fss_s=fss.seconds,
             gc_ot_s=gc_ot.seconds,
             field_s=field.seconds,
         )
 
-    def _shard_frontier(self, shard):  # fhh-race: atomic (pure slice of the frontier, never suspends; reached from the frame-arrival pre-expand)
-        """The frontier view one crawl verb works on: the whole frontier
-        (``shard`` None) or the node span ``[lo, hi)`` of it.  Both
-        servers receive identical shard spans from the leader, so their
-        data-plane exchanges stay positionally matched."""
-        if shard is None:
-            return self.frontier
-        return collect.frontier_slice(
-            self.frontier, shard[0], shard[1], planar=self._planar()
-        )
-
-    def _stash_children(self, level, shard, children) -> None:
-        """Bank one crawl's child-state cache for the coming prune: whole
-        level under ``_children``, shards keyed by span ``lo`` (a shard
-        RE-RUN overwrites its slot — exactly the retry semantics)."""
-        if shard is None:
-            self._children = children
-            return
-        if self._shard_level != int(level):
-            # first shard of a new level: drop any stale spans
-            self._shard_children.clear()
-            self._shard_last.clear()
-            self._shard_level = int(level)
-        self._children = None  # sharded levels assemble at prune time
-        if children is not None:
-            self._shard_children[int(shard[0])] = children
 
     # -- expand stage (device) vs open stage (plane I/O) -----------------
     #
@@ -923,20 +659,20 @@ class CollectorServer:
     # GC/OT network phase with span k+1's device compute (the leader
     # keeps both frames in flight via ``crawl_pipeline_depth``).
 
-    def _do_expand(self, level: int, last: bool, shard) -> dict:  # fhh-race: atomic (dispatch-only device work, never suspends; called both under the verb lock and from the frame-arrival pre-expand)
+    def _do_expand(self, cs, level: int, last: bool, shard) -> dict:  # fhh-race: atomic (dispatch-only device work, never suspends; called both under the session's verb lock and from the frame-arrival pre-expand)
         """Device half of one crawl span: dispatch-only (no sync — a
         block_until_ready here would cost a tunnel RTT); pure function of
         (keys, frontier, level, span), so a shard re-run may reuse it
         bit-identically."""
-        frontier = self._shard_frontier(shard)
+        frontier = cs.shard_frontier_view(shard)
         packed, children = collect.expand_share_bits(
-            self.keys, frontier, level, want_children=not last,
-            use_pallas=False if self._mesh is not None else None,
+            cs.keys, frontier, level, want_children=not last,
+            use_pallas=False if cs._mesh is not None else None,
         )
         out = {"packed": packed, "children": children, "frontier": frontier}
         if self.cfg.secure_exchange:
-            d = self.keys.cw_seed.shape[1]
-            if self._mesh is not None:
+            d = cs.keys.cw_seed.shape[1]
+            if cs._mesh is not None:
                 # row-sharded kernel stage (parallel/kernel_shard.py):
                 # the whole-level planar test batch partitions along its
                 # row/block axis across the data mesh — extension,
@@ -946,7 +682,7 @@ class CollectorServer:
                 F_, N = packed.shape
                 C = 1 << d
                 B = F_ * C * N
-                ks = self._mesh.kernel_bind(
+                ks = cs._mesh.kernel_bind(
                     B, 2 * d, self.cfg.secure_kernel_shards
                 )
                 if ks is not None:
@@ -966,11 +702,11 @@ class CollectorServer:
                 # sync here would block this (possibly frame-arrival)
                 # context for a full tunnel RTT
                 t0 = time.monotonic()
-                packed = self._mesh.gather(packed)
-                self.obs.timer_add(
+                packed = cs._mesh.gather(packed)
+                cs.obs.timer_add(
                     "kernel_gather", time.monotonic() - t0, level=int(level)
                 )
-                self.obs.count("kernel_gathers", level=int(level))
+                cs.obs.count("kernel_gathers", level=int(level))
             strs = secure.child_strings(packed, d)  # [F, C, N, S]
             F_, C, N, S = strs.shape
             out["flat"] = strs.reshape(F_ * C * N, S)
@@ -982,14 +718,14 @@ class CollectorServer:
             _start_host_copy(packed)
         return out
 
-    def _expand_stage(self, level: int, last: bool, shard) -> dict:
-        hit = self._expand_ready.pop((bool(last), int(level), shard), None)
+    def _expand_stage(self, cs, level: int, last: bool, shard) -> dict:
+        hit = cs._expand_ready.pop((bool(last), int(level), shard), None)
         if hit is not None:
-            self.obs.count("pipeline_expand_hits", level=int(level))
+            cs.obs.count("pipeline_expand_hits", level=int(level))
             return hit
-        return self._do_expand(level, last, shard)
+        return self._do_expand(cs, level, last, shard)
 
-    def _maybe_pre_expand(self, verb: str, req) -> None:  # fhh-race: atomic (frame-arrival prefetch: reads frontier/keys and stashes in one event-loop slice; every frontier mutation clears the stash before the next slice)
+    def _maybe_pre_expand(self, cs, verb: str, req) -> None:  # fhh-race: atomic (frame-arrival prefetch: reads frontier/keys and stashes in one event-loop slice; every frontier mutation clears the stash before the next slice)
         """Frame-arrival hook (``_dispatch``, BEFORE the verb lock): run
         the expand stage for a sharded crawl verb while earlier spans
         still hold the lock.  Purely an overlap optimization — any
@@ -998,53 +734,66 @@ class CollectorServer:
         if verb not in ("tree_crawl", "tree_crawl_last"):
             return
         shard = self._parse_shard(req)
-        if shard is None or self.keys is None or self.frontier is None:
+        if shard is None or cs.keys is None or cs.frontier is None:
             return
-        if shard[1] > self.frontier.f_bucket:
+        if shard[1] > cs.frontier.f_bucket:
             return  # span from another life (stale replay): let it fail
         level, last = int(req["level"]), verb == "tree_crawl_last"
         key = (last, level, shard)
         # bound the stash: depth-many entries live at a time in practice;
         # 32 is far above any sane pipeline depth
-        if key in self._expand_ready or len(self._expand_ready) >= 32:
+        if key in cs._expand_ready or len(cs._expand_ready) >= 32:
             return
         try:
             t0 = time.monotonic()
-            self._expand_ready[key] = self._do_expand(level, last, shard)
+            cs._expand_ready[key] = self._do_expand(cs, level, last, shard)
+            # a device dispatch that ran while ANOTHER tenant's span was
+            # on the wire is exactly the gap multi-tenancy fills
+            self._sched.note_dispatch(cs.key)
             # dispatch time only, attributed to the fss phase the verb
             # would otherwise have spent it in (no span: another verb's
             # span may be active on this registry right now)
-            self.obs.timer_add("fss", time.monotonic() - t0, level=level)
-            self.obs.count("pipeline_pre_expands", level=level)
+            cs.obs.timer_add("fss", time.monotonic() - t0, level=level)
+            cs.obs.count("pipeline_pre_expands", level=level)
         except Exception:  # fhh-lint: disable=broad-except (prefetch only: the verb recomputes under the lock and surfaces the real error to the leader)
-            self._expand_ready.pop(key, None)
+            cs._expand_ready.pop(key, None)
 
     async def _crawl_counts(
-        self, level: int, last: bool = False, shard=None
+        self, cs, level: int, last: bool = False, shard=None
     ) -> np.ndarray:
         # per-level phase taxonomy of the reference (collect.rs:412-503);
         # trusted mode's "GC and OT" slot is the plaintext exchange
-        with self.obs.span("fss", level=level) as sp_fss:
-            ex = self._expand_stage(level, last, shard)
+        with cs.obs.span("fss", level=level) as sp_fss:
+            # a device turn: serialized FIFO across tenants (one
+            # accelerator), counted as a stall fill when it ran while
+            # another session waited on the wire.  A pre-expanded span
+            # already dispatched (and was counted) at frame arrival —
+            # don't count the no-op turn, it would inflate the
+            # fill-ratio denominator.  The turn covers DISPATCH only;
+            # the fetch below blocks on device execution and must not
+            # hold other tenants' dispatch out.
+            pre = (bool(last), int(level), shard) in cs._expand_ready
+            async with self._sched.device_turn(cs.key, count=not pre):
+                ex = self._expand_stage(cs, level, last, shard)
             packed, children, frontier = (
                 ex["packed"], ex["children"], ex["frontier"]
             )
             # forces the device work to finish
-            packed_np = await _fetch(packed, self.obs)
-        with self.obs.span("gc_ot", level=level) as sp_gc:
+            packed_np = await _fetch(packed, cs.obs)
+        with cs.obs.span("gc_ot", level=level) as sp_gc:
             # data plane: swap packed share bits with the peer server
-            peer = await self._swap(packed_np)
-        with self.obs.span("field", level=level) as sp_field:
-            masks = collect.pattern_masks(self.keys.cw_seed.shape[1])
+            peer = await self._swap(cs, packed_np)
+        with cs.obs.span("field", level=level) as sp_field:
+            masks = collect.pattern_masks(cs.keys.cw_seed.shape[1])
             counts = await self._reduced_fetch(
-                level, collect.counts_by_pattern,
-                packed, peer, masks, self.alive_keys, frontier.alive,
+                cs, level, collect.counts_by_pattern,
+                packed, peer, masks, cs.alive_keys, frontier.alive,
             )
-        self._emit_level_phases(level, sp_fss, sp_gc, sp_field)
-        self._stash_children(level, shard, children)
+        self._emit_level_phases(cs, level, sp_fss, sp_gc, sp_field)
+        cs.stash_children(level, shard, children)
         return counts
 
-    async def _reduced_fetch(self, level: int, single_fn, *args):
+    async def _reduced_fetch(self, cs, level: int, single_fn, *args):
         """The per-level reduction + host fetch shared by the trusted
         (``collect.counts_by_pattern``) and secure
         (``secure.node_share_sums``) crawl paths.  Under the multi-chip
@@ -1055,12 +804,12 @@ class CollectorServer:
         ``ici_reduce`` span is the reduction's cost instrument.  Either
         way the caller (and with it the wire) gets host values in the
         single-device layout."""
-        if self._mesh is not None:
-            self.obs.gauge("data_shards", self._mesh.shards, level=level)
-            with self.obs.span("ici_reduce", level=level):
-                out = getattr(self._mesh, single_fn.__name__)(*args)
-                return await _fetch(out, self.obs)
-        return await _fetch(single_fn(*args), self.obs)
+        if cs._mesh is not None:
+            cs.obs.gauge("data_shards", cs._mesh.shards, level=level)
+            with cs.obs.span("ici_reduce", level=level):
+                out = getattr(cs._mesh, single_fn.__name__)(*args)
+                return await _fetch(out, cs.obs)
+        return await _fetch(single_fn(*args), cs.obs)
 
     async def _phase_sync(self, x) -> None:
         """Device sync at a secure-kernel phase boundary (OFF the event
@@ -1072,16 +821,16 @@ class CollectorServer:
         if self.cfg.secure_phase_sync:
             await asyncio.to_thread(jax.block_until_ready, x)
 
-    def _zero_phases(self, level: int, *names: str) -> None:
+    def _zero_phases(self, cs, level: int, *names: str) -> None:
         """Materialize zero-valued phase timers so the secure-kernel
         split always carries all four keys on both servers (a garbler
         has no eval phase, the ot2s path has no garble phase — the run
         report must show those as 0, not absent)."""
         for n in names:
-            self.obs.timer_add(n, 0.0, level=level)
+            cs.obs.timer_add(n, 0.0, level=level)
 
     async def _crawl_counts_secure(
-        self, level: int, count_field, last: bool = False, garbler: int = 0,
+        self, cs, level: int, count_field, last: bool = False, garbler: int = 0,
         shard=None, ot_path=None,
     ) -> np.ndarray:
         """The real 2PC data plane (ref: collect.rs:419-501): equality +
@@ -1107,173 +856,181 @@ class CollectorServer:
         ``otext`` (extension), ``garble``/``eval`` (circuit work — zero
         on the ot2s path), and ``b2a`` (payload table / open + field
         conversion); wire waits are the gc_ot remainder."""
-        with self.obs.span("fss", level=level) as sp_fss:
+        with cs.obs.span("fss", level=level) as sp_fss:
             # dispatch time only: the FSS expansion itself overlaps the
             # exchange below (no sync — a block_until_ready here would
             # cost a tunnel RTT); a pipelined leader already ran this
-            # stage at frame arrival (``_maybe_pre_expand``)
-            ex = self._expand_stage(level, last, shard)
+            # stage at frame arrival (``_maybe_pre_expand``).  The
+            # device turn serializes dispatch FIFO across tenants and
+            # counts the stall fills multi-tenancy exists to create —
+            # except for a pre-expanded span, whose dispatch was already
+            # counted at frame arrival (note_dispatch).
+            pre = (bool(last), int(level), shard) in cs._expand_ready
+            async with self._sched.device_turn(cs.key, count=not pre):
+                ex = self._expand_stage(cs, level, last, shard)
             children, frontier, flat = (
                 ex["children"], ex["frontier"], ex["flat"]
             )
             F_, C, N, S = ex["dims"]
             B = F_ * C * N
-            self.obs.count("gc_tests", B, level=level)
-            self.obs.gauge("ot_batch_size", B * S, level=level)
-        with self.obs.span("gc_ot", level=level) as sp_gc:
-            w = secure.alive_weight(frontier.alive, self.alive_keys, C)
+            cs.obs.count("gc_tests", B, level=level)
+            cs.obs.gauge("ot_batch_size", B * S, level=level)
+        with cs.obs.span("gc_ot", level=level) as sp_gc:
+            w = secure.alive_weight(frontier.alive, cs.alive_keys, C)
             # crawl counter makes every garbling's randomness unique even
             # if a leader re-crawls a level without reset (seed reuse with
             # a fixed R = s would leak cross-run equality deltas to the
             # evaluator)
-            self._crawl_ctr += 1
-            gc_seed = secure.derive_seed(self._sec_seed, 1, level, self._crawl_ctr)
-            b2a_seed = secure.derive_seed(self._sec_seed, 2, level, self._crawl_ctr)
+            cs._crawl_ctr += 1
+            gc_seed = secure.derive_seed(cs._sec_seed, 1, level, cs._crawl_ctr)
+            b2a_seed = secure.derive_seed(cs._sec_seed, 2, level, cs._crawl_ctr)
             # the leader names the path per verb (like ``garbler``) so
             # both servers always agree on the wire format even when a
             # bench/parity leader overrides its own config; absent, the
             # server's config decides
             path = secure.ot_path(S, ot_path or self.cfg.ot_path)
-            self.obs.count(f"ot_path_{path}", level=level)
+            cs.obs.count(f"ot_path_{path}", level=level)
             W = secure.payload_words(count_field)
             ks = ex.get("kernel")
-            if self._mesh is not None:
+            if cs._mesh is not None:
                 # per-level kernel layout: the active row-shard count (1
                 # = the degraded gather path) feeds the mesh report
                 # section and the acceptance gate (kernel_gather ~ 0)
-                self.obs.gauge(
+                cs.obs.gauge(
                     "kernel_shards", ks.k if ks is not None else 1,
                     level=level,
                 )
             if self.server_id == garbler:  # garbler/sender + OT-ext sender
-                u = await self._dp_recv()
+                u = await self._dp_recv(cs)
                 if ks is not None:
                     # ROW-SHARDED kernel stage: extension, payload pair,
                     # and the equality kernel all run per mesh shard
                     # (parallel/kernel_shard.py); the frame reads back
                     # per shard and reassembles positionally — nothing
                     # gathers onto one device
-                    with self.obs.span("otext", level=level):
+                    with cs.obs.span("otext", level=level):
                         q, idx0 = kernel_shard.snd_extend(
-                            ks, self._ot_snd, u
+                            ks, cs._ot_snd, u
                         )
                         await self._phase_sync(q)
                     kphase = "b2a" if path == "ot2s" else "garble"
-                    with self.obs.span(kphase, level=level):
+                    with cs.obs.span(kphase, level=level):
                         planes, vals = kernel_shard.gb_kernel(
-                            ks, self._ot_snd.s_block, q, flat, gc_seed,
+                            ks, cs._ot_snd.s_block, q, flat, gc_seed,
                             b2a_seed, count_field, garbler, path, idx0,
                         )
                         await self._phase_sync(planes)
                     self._zero_phases(
+                        cs,
                         level, "eval",
                         *(("garble",) if path == "ot2s" else ("b2a",)),
                     )
-                    self.obs.count("device_fetches", ks.k, level=level)
+                    cs.obs.count("device_fetches", ks.k, level=level)
                     # msg_wire starts the per-shard D2H copies itself
                     msg_np = await asyncio.to_thread(
                         kernel_shard.msg_wire, ks, planes
                     )
-                    await self._dp_send(msg_np)
+                    await self._dp_send(cs, msg_np)
                 else:
-                    with self.obs.span("otext", level=level):
-                        idx0 = self._ot_snd.consumed
-                        q = self._ot_snd.extend(B * S, u)
+                    with cs.obs.span("otext", level=level):
+                        idx0 = cs._ot_snd.consumed
+                        q = cs._ot_snd.extend(B * S, u)
                         await self._phase_sync(q)
-                    with self.obs.span("b2a", level=level):
+                    with cs.obs.span("b2a", level=level):
                         vals, w0, w1 = secure.b2a_payload_pair(
                             count_field, b2a_seed, B, garbler
                         )
                         if path == "ot2s":
                             msg = secure.ot2s_encrypt_packed(
                                 q.reshape(B, S, 4),
-                                jnp.asarray(self._ot_snd.s_block), flat,
+                                jnp.asarray(cs._ot_snd.s_block), flat,
                                 w1, w0, W, idx0,
                             )
                         await self._phase_sync(w1 if path != "ot2s" else msg)
                     if path == "ot2s":
-                        self._zero_phases(level, "garble", "eval")
+                        self._zero_phases(cs, level, "garble", "eval")
                     else:
-                        with self.obs.span("garble", level=level):
+                        with cs.obs.span("garble", level=level):
                             msg, _ = gc.garble_equality_payload_packed(
-                                jnp.asarray(self._ot_snd.s_block),
+                                jnp.asarray(cs._ot_snd.s_block),
                                 q.reshape(B, S, 4), jnp.asarray(gc_seed),
                                 flat, w1, w0, W, idx0,
                             )
                             await self._phase_sync(msg)
-                        self._zero_phases(level, "eval")
-                    await self._dp_send(await _fetch(msg, self.obs))
+                        self._zero_phases(cs, level, "eval")
+                    await self._dp_send(cs, await _fetch(msg, cs.obs))
             else:  # evaluator + OT receiver (inputs stay on device: each
                 # np.asarray here would cost a full tunnel round trip)
                 if ks is not None:
-                    with self.obs.span("otext", level=level):
+                    with cs.obs.span("otext", level=level):
                         u_arr, t_rows, idx0 = kernel_shard.rcv_extend(
-                            ks, self._ot_rcv, flat
+                            ks, cs._ot_rcv, flat
                         )
-                        self.obs.count("device_fetches", ks.k, level=level)
+                        cs.obs.count("device_fetches", ks.k, level=level)
                         # u_wire starts the per-shard D2H copies itself
                         u_np = await asyncio.to_thread(
                             kernel_shard.u_wire, ks, u_arr
                         )
-                    await self._dp_send(u_np)
-                    bmsg = await self._dp_recv()
+                    await self._dp_send(cs, u_np)
+                    bmsg = await self._dp_recv(cs)
                     kphase = "b2a" if path == "ot2s" else "eval"
-                    with self.obs.span(kphase, level=level):
+                    with cs.obs.span(kphase, level=level):
                         vals = kernel_shard.ev_open(
                             ks, t_rows, flat, bmsg, count_field, path, idx0
                         )
                         await self._phase_sync(vals)
                     self._zero_phases(
+                        cs,
                         level, "garble",
                         *(("eval",) if path == "ot2s" else ("b2a",)),
                     )
                 else:
-                    with self.obs.span("otext", level=level):
+                    with cs.obs.span("otext", level=level):
                         u, t_rows, idx0 = secure.ev_step1_fused(
-                            self._ot_rcv, flat
+                            cs._ot_rcv, flat
                         )
-                        u_np = await _fetch(u, self.obs)  # forces the extension
-                    await self._dp_send(u_np)
-                    bmsg = await self._dp_recv()
+                        u_np = await _fetch(u, cs.obs)  # forces the extension
+                    await self._dp_send(cs, u_np)
+                    bmsg = await self._dp_recv(cs)
                     if path == "ot2s":
-                        with self.obs.span("b2a", level=level):
+                        with cs.obs.span("b2a", level=level):
                             pay = secure.ot2s_decrypt_packed(
                                 jnp.asarray(t_rows).reshape(B, S, 4), flat,
                                 bmsg, W, idx0,
                             )
                             vals = secure.words_to_field(count_field, pay)
                             await self._phase_sync(vals)
-                        self._zero_phases(level, "garble", "eval")
+                        self._zero_phases(cs, level, "garble", "eval")
                     else:
-                        with self.obs.span("eval", level=level):
+                        with cs.obs.span("eval", level=level):
                             _, pay = gc.eval_equality_payload_packed(
                                 bmsg, jnp.asarray(t_rows).reshape(B, S, 4),
                                 W, idx0,
                             )
                             await self._phase_sync(pay)
-                        with self.obs.span("b2a", level=level):
+                        with cs.obs.span("b2a", level=level):
                             vals = secure.words_to_field(count_field, pay)
                             await self._phase_sync(vals)
-                        self._zero_phases(level, "garble")
-        with self.obs.span("field", level=level) as sp_field:
+                        self._zero_phases(cs, level, "garble")
+        with cs.obs.span("field", level=level) as sp_field:
             if ks is not None:
                 # test-sharded b2a shares: scatter into the (F, C, N)
                 # frame per shard, alive-gate, and psum back over ICI —
                 # the kernel-stage twin of ServerMesh.node_share_sums
-                self.obs.gauge("data_shards", self._mesh.shards, level=level)
-                with self.obs.span("ici_reduce", level=level):
+                cs.obs.gauge("data_shards", cs._mesh.shards, level=level)
+                with cs.obs.span("ici_reduce", level=level):
                     out = kernel_shard.share_sums(
                         ks, count_field, vals, w, F_, C, N
                     )
-                    shares = await _fetch(out, self.obs)
+                    shares = await _fetch(out, cs.obs)
             else:
                 vals = vals.reshape((F_, C, N) + count_field.limb_shape)
                 shares = await self._reduced_fetch(
-                    level, secure.node_share_sums,
+                    cs, level, secure.node_share_sums,
                     count_field, vals, jnp.asarray(w),
                 )
-        self._emit_level_phases(level, sp_fss, sp_gc, sp_field)
-        self._stash_children(level, shard, children)
+        self._emit_level_phases(cs, level, sp_fss, sp_gc, sp_field)
+        cs.stash_children(level, shard, children)
         return shares
 
     @staticmethod
@@ -1281,27 +1038,7 @@ class CollectorServer:
         s = (req or {}).get("shard")
         return None if s is None else (int(s[0]), int(s[1]))
 
-    def _mask_rows(self, level: int, shard, C: int, f255: bool) -> np.ndarray:
-        """Wire-format mask rows for one (level, shard): the FULL-level
-        stream sliced to the shard's node rows — the leader's uniform
-        v0 - v1 reconstruction must be shard-oblivious, so a node's mask
-        cannot depend on how the level was sharded.  One-entry cache: the
-        S shard verbs of a level would otherwise each regenerate the
-        whole level's stream (the mask is a pure function of
-        (level, size), so staleness is impossible)."""
-        F = self.frontier.f_bucket
-        key = (level, F, f255)
-        if self._mask_cache is None or self._mask_cache[0] != key:
-            full = (
-                mask_f255(level, F * C).reshape(F, C, 8)
-                if f255
-                else mask_fe62(level, F * C).reshape(F, C)
-            )
-            self._mask_cache = (key, full)
-        full = self._mask_cache[1]
-        return full if shard is None else full[shard[0] : shard[1]]
-
-    async def _mesh_guard(self, level, thunk):
+    async def _mesh_guard(self, cs, level, thunk):
         """Device-loss containment for the multi-chip server: fire any
         scheduled mesh chaos at the crawl boundary (the same consumed-
         once :class:`resilience.chaos.MeshChaos` schedule the 2-D mesh
@@ -1317,34 +1054,38 @@ class CollectorServer:
         the re-run exchanges with the peer exactly once; a real device
         loss mid-exchange desynchronizes the plane and correctly
         escalates through the verb error to the leader's plane_reset +
-        retry machinery instead."""
+        retry machinery instead.  The chaos schedule receives the
+        SESSION (it clobbers ``frontier``/``_children`` on a kill) —
+        whichever tenant's crawl reaches the scheduled level first eats
+        the fault, which is exactly the tenant-isolation scenario the
+        chaos suite asserts."""
         try:
             if self._mesh_chaos is not None:
-                self._mesh_chaos.before_level(self, int(level))
+                self._mesh_chaos.before_level(cs, int(level))
             return await thunk()
         except reschaos.MeshFaultError as err:
-            if self._mesh is None:
+            if cs._mesh is None:
                 raise
-            await self._mesh_recover(int(level), err)
+            await self._mesh_recover(cs, int(level), err)
             return await thunk()
 
-    async def _mesh_recover(self, level: int, err) -> None:
+    async def _mesh_recover(self, cs, level: int, err) -> None:
         """Re-shard after a device loss (see :meth:`_mesh_guard`)."""
-        self.obs.count("mesh_faults", level=level)
-        self._expand_ready.clear()  # pre-expanded dispatches are suspect
+        cs.obs.count("mesh_faults", level=level)
+        cs._expand_ready.clear()  # pre-expanded dispatches are suspect
         state_lost = bool(getattr(err, "state_lost", False))
-        if state_lost or self.frontier is None:
+        if state_lost or cs.frontier is None:
             prev = level - 1
-            if self.ckpt_dir is None or prev not in self._ckpt_levels():
+            if cs.ckpt_dir is None or prev not in cs.ckpt_levels():
                 # nothing to re-shard from: surface the original fault —
                 # the supervising leader owns recovery at that point
                 raise RuntimeError(
                     f"mesh device lost at level {level} with no level-"
                     f"{prev} checkpoint to re-shard from"
                 ) from err
-            self.keys = None  # device-resident: lost with the shard
-            await self.tree_restore({"level": prev})
-            if self.frontier is None or self.keys is None:
+            cs.keys = None  # device-resident: lost with the shard
+            await self.tree_restore({"level": prev}, cs)
+            if cs.frontier is None or cs.keys is None:
                 # the level stamp existed but the blob was ingest-only
                 # (windowed front door between windows): pools came
                 # back, crawl state did not — escalate exactly like the
@@ -1354,40 +1095,45 @@ class CollectorServer:
                     f"{prev} checkpoint is ingest-only — no crawl state "
                     "to re-shard from"
                 ) from err
-            self.obs.count("mesh_reshards", level=level)
-        self.obs.count("shards_rerun", level=level)
+            cs.obs.count("mesh_reshards", level=level)
+        cs.obs.count("shards_rerun", level=level)
         obs.emit(
             "resilience.mesh_reshard",
             severity="warn",
             server=self.server_id,
+            collection=cs.key,
             level=level,
             state_lost=state_lost,
             error=str(err),
         )
 
-    async def tree_crawl(self, req) -> np.ndarray:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
+    async def tree_crawl(self, req, cs: CollectionSession | None = None) -> np.ndarray:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the session's verb lock; sanitizer-validated)
         """-> FE62 shares of per-child counts [F, 2^d] (ref: rpc.rs:60).
         An optional ``shard: (lo, hi)`` restricts the crawl to that node
         span (mid-level retry granularity — the leader assembles)."""
+        cs = cs if cs is not None else self._default()
         level = req["level"]
         shard = self._parse_shard(req)
+        # a plane reset since the last exchange re-keys this session's
+        # channel (fresh coin flip + base-OT) before any wire I/O
+        await self._ensure_session_plane(cs)
         if self.cfg.secure_exchange:
             return await self._mesh_guard(
-                level,
+                cs, level,
                 lambda: self._crawl_counts_secure(
-                    level, FE62, garbler=int(req.get("garbler", 0)),
+                    cs, level, FE62, garbler=int(req.get("garbler", 0)),
                     shard=shard, ot_path=req.get("ot_path"),
                 ),
             )
         counts = await self._mesh_guard(
-            level, lambda: self._crawl_counts(level, shard=shard)
+            cs, level, lambda: self._crawl_counts(cs, level, shard=shard)
         )
         # NB: trusted mode — both servers hold these plaintext counts; the
         # shared-seed mask below is a WIRE-FORMAT shim so the leader's
         # uniform v0 - v1 reconstruction works, not a secrecy mechanism
         # (the reference's hardcoded bogus PRG seed plays the same role,
         # server.rs:331-332).  Secrecy comes from secure_exchange above.
-        r = self._mask_rows(level, shard, counts.shape[-1], f255=False)
+        r = cs.mask_rows(level, shard, counts.shape[-1], f255=False)
         if self.server_id == 0:
             # counts are already host-side; the mask add stays host-side
             # too (FE62.np_add) — the old device add + _fetch cost a full
@@ -1395,28 +1141,30 @@ class CollectorServer:
             return FE62.np_add(counts.astype(np.uint64), r)
         return r
 
-    async def tree_crawl_last(self, req) -> np.ndarray:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
+    async def tree_crawl_last(self, req, cs: CollectionSession | None = None) -> np.ndarray:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the session's verb lock; sanitizer-validated)
         """-> F255 shares [F, 2^d, 8] for the final level (ref: rpc.rs:61,
         collect.rs:775-916 — BlockPair double-block OT payloads in secure
         mode).  Shares are retained for final_shares re-serving; sharded
         calls bank their span and ``tree_prune_last`` assembles."""
+        cs = cs if cs is not None else self._default()
         level = req["level"]
         shard = self._parse_shard(req)
+        await self._ensure_session_plane(cs)
         if self.cfg.secure_exchange:
             shares = await self._mesh_guard(
-                level,
+                cs, level,
                 lambda: self._crawl_counts_secure(
-                    level, F255, last=True,
+                    cs, level, F255, last=True,
                     garbler=int(req.get("garbler", 0)), shard=shard,
                     ot_path=req.get("ot_path"),
                 ),
             )
         else:
             counts = await self._mesh_guard(
-                level,
-                lambda: self._crawl_counts(level, last=True, shard=shard),
+                cs, level,
+                lambda: self._crawl_counts(cs, level, last=True, shard=shard),
             )
-            r = self._mask_rows(level, shard, counts.shape[-1], f255=True)
+            r = cs.mask_rows(level, shard, counts.shape[-1], f255=True)
             if self.server_id == 0:
                 c = np.zeros(counts.shape + (8,), np.uint32)
                 c[..., 0] = counts
@@ -1425,80 +1173,64 @@ class CollectorServer:
             else:
                 shares = r
         if shard is None:
-            self._last_shares = shares
+            cs._last_shares = shares
         else:
-            self._last_shares = None
-            self._shard_last[int(shard[0])] = shares
+            cs._last_shares = None
+            cs._shard_last[int(shard[0])] = shares
         return shares
 
-    async def tree_prune(self, req) -> bool:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
+    async def tree_prune(self, req, cs: CollectionSession | None = None) -> bool:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the session's verb lock; sanitizer-validated)
         """Fused prune+advance: materialize surviving children
         (ref: rpc.rs:63 tree_prune + collect.rs:918-929).  The sketch DPF
         states advance with the same survivor table."""
+        cs = cs if cs is not None else self._default()
         level = req["level"]
         # fhh-lint: disable=host-sync-in-hot-loop (wire input: host numpy)
         parent = np.asarray(req["parent_idx"], np.int32)
         # fhh-lint: disable=host-sync-in-hot-loop (wire input: host numpy)
         pat_bits = np.asarray(req["pattern_bits"], bool)
         n_alive = int(req["n_alive"])
-        self._expand_ready.clear()  # the frontier is about to mutate
-        if self._children is None and self._shard_children:
-            self._children = self._assemble_shard_children()
-        if self._children is not None:  # cache from this level's crawl
-            self.frontier = collect.advance_from_children(
-                self._children, parent, pat_bits, n_alive
+        cs._expand_ready.clear()  # the frontier is about to mutate
+        if cs._children is None and cs._shard_children:
+            cs._children = cs.assemble_shard_children()
+        if cs._children is not None:  # cache from this level's crawl
+            cs.frontier = collect.advance_from_children(
+                cs._children, parent, pat_bits, n_alive
             )
-            self._children = None
+            cs._children = None
         else:  # prune without a preceding crawl: re-expand
-            self.frontier = collect.advance(
-                self.keys, self.frontier, level, parent, pat_bits, n_alive,
-                use_pallas=False if self._mesh is not None else None,
+            cs.frontier = collect.advance(
+                cs.keys, cs.frontier, level, parent, pat_bits, n_alive,
+                use_pallas=False if cs._mesh is not None else None,
             )
-        if self._sketch is not None:
-            self._advance_sketch(int(level), parent, pat_bits, n_alive)
-            self._ratchet_digest = sketchmod.transcript_absorb(
-                self._ratchet_digest, int(level), parent, pat_bits, n_alive
+        if cs._sketch is not None:
+            cs.advance_sketch(int(level), parent, pat_bits, n_alive)
+            cs._ratchet_digest = sketchmod.transcript_absorb(
+                cs._ratchet_digest, int(level), parent, pat_bits, n_alive
             )
-        self.obs.gauge("survivors", n_alive, level=int(level))
+        cs.obs.gauge("survivors", n_alive, level=int(level))
         return True
 
-    def _assemble_shard_children(self):
-        """Stitch the per-shard child caches back into one full-level
-        cache; refuses a torn level (a missing span would silently
-        advance garbage for its nodes)."""
-        children = collect.children_cat(sorted(self._shard_children.items()))
-        got = (
-            children.seed.shape[4]
-            if isinstance(children, collect.PlanarChildren)
-            else children.seed.shape[0]
-        )
-        if got != self.frontier.f_bucket:
-            raise RuntimeError(
-                f"sharded crawl incomplete: child caches cover {got} of "
-                f"{self.frontier.f_bucket} frontier slots"
-            )
-        self._shard_children.clear()
-        return children
-
-    async def tree_prune_last(self, req) -> bool:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
+    async def tree_prune_last(self, req, cs: CollectionSession | None = None) -> bool:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the session's verb lock; sanitizer-validated)
         """Last level keeps no child count states to advance — compact the
         stored leaf count shares down to the survivors
         (ref: collect.rs:931-942).  The sketch DPF does advance once more
         so its F255 leaf payloads can be verified post-prune."""
-        self._expand_ready.clear()  # leaf level: nothing expands past it
-        if self._last_shares is None and self._shard_last:
-            parts = sorted(self._shard_last.items())
+        cs = cs if cs is not None else self._default()
+        cs._expand_ready.clear()  # leaf level: nothing expands past it
+        if cs._last_shares is None and cs._shard_last:
+            parts = sorted(cs._shard_last.items())
             whole = np.concatenate([p for _, p in parts], axis=0)
-            if whole.shape[0] != self.frontier.f_bucket:
+            if whole.shape[0] != cs.frontier.f_bucket:
                 raise RuntimeError(
                     f"sharded last crawl incomplete: shares cover "
-                    f"{whole.shape[0]} of {self.frontier.f_bucket} slots"
+                    f"{whole.shape[0]} of {cs.frontier.f_bucket} slots"
                 )
-            self._last_shares = whole
-            self._shard_last.clear()
-        if self._last_shares is None:  # protocol-boundary check: no assert
+            cs._last_shares = whole
+            cs._shard_last.clear()
+        if cs._last_shares is None:  # protocol-boundary check: no assert
             raise RuntimeError("tree_prune_last called before tree_crawl_last")
-        self._children = None  # leaf level: nothing advances past it
+        cs._children = None  # leaf level: nothing advances past it
         # fhh-lint: disable=host-sync-in-hot-loop (wire input: host numpy)
         parent = np.asarray(req["parent_idx"], np.int64)
         # fhh-lint: disable=host-sync-in-hot-loop (wire input: host numpy)
@@ -1506,65 +1238,35 @@ class CollectorServer:
         n_alive = int(req["n_alive"])
         d = pattern.shape[1]
         child = (pattern[:n_alive] << np.arange(d)).sum(axis=1)
-        self._last_shares = self._last_shares[parent[:n_alive], child]
-        if self._sketch is not None:
-            L = self.keys.cw_seed.shape[-2]
-            self._advance_sketch(
+        cs._last_shares = cs._last_shares[parent[:n_alive], child]
+        if cs._sketch is not None:
+            L = cs.keys.cw_seed.shape[-2]
+            cs.advance_sketch(
                 # fhh-lint: disable=host-sync-in-hot-loop (wire input)
                 L - 1, np.asarray(req["parent_idx"], np.int32), pattern, n_alive
             )
-            self._ratchet_digest = sketchmod.transcript_absorb(
-                self._ratchet_digest, L - 1, parent, pattern, n_alive
+            cs._ratchet_digest = sketchmod.transcript_absorb(
+                cs._ratchet_digest, L - 1, parent, pattern, n_alive
             )
-        self.obs.gauge(
-            "survivors", n_alive, level=self.keys.cw_seed.shape[-2] - 1
+        cs.obs.gauge(
+            "survivors", n_alive, level=cs.keys.cw_seed.shape[-2] - 1
         )
         return True
 
-    async def final_shares(self, req) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
+    async def final_shares(self, req, cs: CollectionSession | None = None) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the session's verb lock; sanitizer-validated)
         """Re-serve the surviving leaves' count shares for leader-side
         reconstruction (ref: rpc.rs:65, collect.rs:993-1004; tree paths
         live with the leader in this design, see protocol/collect.py)."""
-        return {"server_id": self.server_id, "shares": self._last_shares}
+        cs = cs if cs is not None else self._default()
+        return {"server_id": self.server_id, "shares": cs._last_shares}
 
     # -- streaming ingest front door (ROADMAP "Streaming ingestion": the
-    # online successor of the one-shot add_keys upload) ------------------
+    # online successor of the one-shot add_keys upload).  Pools and the
+    # admission gate are PER SESSION (sessions.CollectionSession): each
+    # collection has its own token bucket, quotas, and reservoir, so a
+    # flooding tenant exhausts only its own gate. --------------------------
 
-    def _ingest_pool(self, window: int) -> _WindowPool:  # fhh-race: atomic (create-or-get + bounded eviction in one event-loop slice; called from the unlocked ingest fast path and from locked verbs)
-        """Create-or-get the pool for ``window``; live-window count is
-        BOUNDED (``cfg.ingest_windows_retained``) so a runaway window id
-        can never grow server memory — the refusal is loud, never a
-        silent drop."""
-        pool = self._ingest_pools.get(window)
-        if pool is None:
-            if len(self._ingest_pools) >= max(
-                1, self.cfg.ingest_windows_retained
-            ):
-                # sealed EMPTY windows are fully consumed (window_load
-                # skips them, so only loads drop pools): evict the
-                # oldest such before refusing — a quiet stretch of idle
-                # windows must not wedge the front door
-                idle = [
-                    w for w in sorted(self._ingest_pools)
-                    if self._ingest_pools[w].sealed
-                    and not self._ingest_pools[w].entries
-                ]
-                if idle:
-                    del self._ingest_pools[idle[0]]
-            if len(self._ingest_pools) >= max(
-                1, self.cfg.ingest_windows_retained
-            ):
-                raise RuntimeError(
-                    f"ingest window {window} would exceed the "
-                    f"{self.cfg.ingest_windows_retained} live-window bound "
-                    f"(live: {sorted(self._ingest_pools)})"
-                )
-            pool = self._ingest_pools[window] = _WindowPool(
-                window, self._admission.window(window)
-            )
-        return pool
-
-    async def submit_keys(self, req) -> dict:  # fhh-race: atomic (unlocked ingest fast path: never suspends, so admission+append is one event-loop slice; rides concurrently with a crawl HOLDING the verb lock — that concurrency is the front door's whole point)
+    async def submit_keys(self, req, cs: CollectionSession | None = None) -> dict:  # fhh-race: atomic (unlocked ingest fast path: never suspends, so admission+append is one event-loop slice; rides concurrently with a crawl HOLDING the verb lock — that concurrency is the front door's whole point)
         """Streaming key submission into the named window's pool —
         admission-controlled, append-only, idempotent per ``sub_id``.
 
@@ -1583,6 +1285,7 @@ class CollectorServer:
         "shed": True}`` (reservoir mode — final), or ``{"admitted":
         False, "overloaded": True, scope, retry_after_s}`` (retryable:
         the client's RetryPolicy backs off and re-attempts)."""
+        cs = cs if cs is not None else self._default()
         if self.cfg.malicious:
             raise RuntimeError(
                 "streaming ingest does not carry sketch material yet — "
@@ -1593,15 +1296,15 @@ class CollectorServer:
         # fhh-lint: disable=chunked-device-readback (wire input: pickled host numpy, no device involved)
         chunk = tuple(np.asarray(a) for a in req["keys"])
         n_keys = int(chunk[0].shape[0])
-        pool = self._ingest_pool(window)
-        self.obs.count("pool_submits")
+        pool = cs.ingest_pool(window)
+        cs.obs.count("pool_submits")
         prev = pool.verdicts.get(sub_id)
         if prev is not None:
             # at-least-once delivery made safe: a replayed submission
             # (reconnect replay under a new req_id, recovery journal
             # replay) answers its RECORDED verdict — the pool and the
             # reservoir RNG are untouched, so nothing double-admits
-            self.obs.count("pool_dup_submits")
+            cs.obs.count("pool_dup_submits")
             return dict(prev, dup=True)
         if pool.sealed:
             raise RuntimeError(
@@ -1614,33 +1317,35 @@ class CollectorServer:
                 sub_id, chunk, mirror, str(req.get("client_id", ""))
             )
         else:
-            v = self._admission.admit(
+            v = cs._admission.admit(
                 pool.wa, str(req.get("client_id", "")), n_keys
             )
             resp = pool.apply(sub_id, chunk, v)
         if resp.get("admitted"):
-            self.obs.count("pool_admitted_keys", n_keys)
+            cs.obs.count("pool_admitted_keys", n_keys)
         elif resp.get("shed"):
-            self.obs.count("pool_shed_keys", n_keys)
+            cs.obs.count("pool_shed_keys", n_keys)
         else:
-            self.obs.count("pool_rejected")
+            cs.obs.count("pool_rejected")
         return resp
 
-    async def window_seal(self, req) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
+    async def window_seal(self, req, cs: CollectionSession | None = None) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the session's verb lock; sanitizer-validated)
         """Freeze the named window at its boundary: no further
         submissions land in it (later ``submit_keys`` name later
         windows); returns the pool stats.  Idempotent — re-sealing a
         sealed window (recovery replays) returns the same stats."""
+        cs = cs if cs is not None else self._default()
         w = int(req["window"])
-        pool = self._ingest_pools.get(w)
+        pool = cs._ingest_pools.get(w)
         if pool is None:
-            pool = self._ingest_pool(w)  # sealing an idle window is legal
+            pool = cs.ingest_pool(w)  # sealing an idle window is legal
         if not pool.sealed:
             pool.sealed = True
-            self.obs.count("windows_sealed")
+            cs.obs.count("windows_sealed")
             obs.emit(
                 "ingest.window_sealed",
                 server=self.server_id,
+                collection=cs.key,
                 window=w,
                 keys=pool.keys,
                 subs=len(pool.entries),
@@ -1648,7 +1353,7 @@ class CollectorServer:
             )
         return pool.stats()
 
-    async def window_load(self, req) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
+    async def window_load(self, req, cs: CollectionSession | None = None) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the session's verb lock; sanitizer-validated)
         """Materialize a SEALED window's frozen pool as the crawl's key
         batch (the streaming twin of the ``add_keys`` upload): the crawl
         state resets to empty, ``keys_parts`` becomes the pool's
@@ -1656,99 +1361,109 @@ class CollectorServer:
         level loop runs on it — while ``submit_keys`` keeps landing in
         later windows.  Ingest pools and checkpoint files are untouched;
         consumed EARLIER windows are dropped (bounded live windows)."""
+        cs = cs if cs is not None else self._default()
         w = int(req["window"])
-        pool = self._ingest_pools.get(w)
+        pool = cs._ingest_pools.get(w)
         if pool is None:
             raise RuntimeError(f"window_load: no ingest pool for window {w}")
         if not pool.sealed:
             raise RuntimeError(f"window_load: window {w} is not sealed")
         if not pool.entries:
             raise RuntimeError(f"window_load: window {w} admitted no keys")
-        self.keys_parts = [IbDcfKeyBatch(*e) for e in pool.entries]
-        self.keys = None
-        self.alive_keys = None
-        self.frontier = None
-        self._children = None
-        self._last_shares = None
-        self._shard_children.clear()
-        self._shard_last.clear()
-        self._shard_level = None
-        self._expand_ready.clear()
-        for old in [k for k in self._ingest_pools if k < w]:
-            del self._ingest_pools[old]
+        cs.keys_parts = [IbDcfKeyBatch(*e) for e in pool.entries]
+        cs.clear_crawl_state()
+        for old in [k for k in cs._ingest_pools if k < w]:
+            del cs._ingest_pools[old]
         obs.emit(
             "ingest.window_loaded",
             server=self.server_id,
+            collection=cs.key,
             window=w,
             keys=pool.keys,
         )
         return {"window": w, "keys": pool.keys, "subs": len(pool.entries)}
 
-    def _ingest_status(self) -> dict:
-        """Front-door health for ``status``: per-window occupancy, the
-        unsealed-queue depth, and the admit/shed/reject counters —
-        enough for an operator (or a test) to see a stalled or shedding
-        ingest plane without scraping logs."""
-        pools = [self._ingest_pools[w] for w in sorted(self._ingest_pools)]
-        unsealed = [p for p in pools if not p.sealed]
-        return {
-            "current_window": (
-                unsealed[-1].window if unsealed
-                else (pools[-1].window if pools else None)
-            ),
-            "queue_depth": sum(p.keys for p in unsealed),
-            "admitted": sum(p.admitted_keys for p in pools),
-            "shed": sum(p.shed_keys for p in pools),
-            "rejected": sum(p.rejected for p in pools),
-            "windows": {
-                str(p.window): {
-                    "keys": p.keys,
-                    "subs": len(p.entries),
-                    "sealed": p.sealed,
-                }
-                for p in pools
-            },
-        }
-
     # -- resilience verbs (no reference analogue: the reference's only
     # recovery verb is reset, server.rs:64-69) ---------------------------
 
-    async def status(self, _req) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
+    async def status(self, _req, cs: CollectionSession | None = None) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the session's verb lock; sanitizer-validated)
         """Cheap probe for the supervising leader: the boot id tells a
         reconnecting leader whether this is the same process (replay is
         safe) or a restart (state is gone — restore path), and the dedup
-        counter lets recovery tests assert no verb double-applied."""
+        counter lets recovery tests assert no verb double-applied.
+
+        The crawl/ingest/mesh fields describe the CALLING session (so
+        single-tenant probes read exactly as before); ``sessions`` is
+        the multi-tenant rollup — every live collection's phase, level,
+        queue depth, replay-dedup entries, and checkpoint levels, plus
+        the tenant scheduler's stall-fill accounting."""
+        cs = cs if cs is not None else self._default()
         return {
             "boot_id": self._boot_id,
-            "has_keys": self.keys is not None or bool(self.keys_parts),
-            "has_frontier": self.frontier is not None,
+            "collection": cs.key,
+            "has_keys": cs.keys is not None or bool(cs.keys_parts),
+            "has_frontier": cs.frontier is not None,
             "dedup_hits": int(self.obs.counter_value("dedup_hits")),
             "plane_resets": int(self.obs.counter_value("plane_resets")),
             # numerically-ordered checkpoint levels on disk — the
             # supervisor's "latest checkpoint" source of truth (string
             # sorts would order l9 after l10 from level 10 on)
-            "ckpt_levels": self._ckpt_levels(),
+            "ckpt_levels": cs.ckpt_levels(),
             # streaming front-door health (pool occupancy per window,
             # unsealed queue depth, admit/shed/reject counters)
-            "ingest": self._ingest_status(),
+            "ingest": cs.ingest_status(),
             # multi-chip mesh health (None on a single-device server):
             # device/shard counts, per-shard client occupancy, and the
             # reduction/recovery instruments the run report rolls up
-            "mesh": self._mesh_status(),
+            "mesh": self._mesh_status(cs),
+            # multi-tenant rollup (sessions.SessionTable + tenancy)
+            "sessions": self._sessions_status(),
         }
 
-    def _mesh_status(self) -> dict | None:
-        if self._mesh is None:
+    def _sessions_status(self) -> dict:  # fhh-race: atomic (read-only rollup over the session table in one event-loop slice; per-session reads are point-in-time probes for an operator, not protocol state)
+        """The ``status.sessions`` section: one row per live collection
+        plus the tenant scheduler's stall-fill accounting."""
+        dedup_by: dict[str, int] = {}
+        with guards.unguarded(
+            "status rollup: point-in-time operator probe over the "
+            "replay table (atomic contract on _sessions_status)"
+        ):
+            for sess in self._sessions.values():
+                key = getattr(sess, "collection", DEFAULT_COLLECTION)
+                dedup_by[key] = dedup_by.get(key, 0) + len(sess.cache)
+            rows = {}
+            for key, cs in self._table.items():
+                sp = cs.obs.current_span()
+                pools = list(cs._ingest_pools.values())
+                rows[key] = {
+                    "phase": sp.name if sp is not None else None,
+                    "level": sp.level if sp is not None else None,
+                    "queue_depth": sum(
+                        p.keys for p in pools if not p.sealed
+                    ),
+                    "dedup_entries": dedup_by.get(key, 0),
+                    "ckpt_levels": cs.ckpt_levels(),
+                    "has_frontier": cs.frontier is not None,
+                    "plane_epoch": cs.plane_epoch,
+                }
+        return {
+            "count": len(self._table),
+            "scheduler": self._sched.stats(),
+            "per_session": rows,
+        }
+
+    def _mesh_status(self, cs: CollectionSession) -> dict | None:
+        if cs._mesh is None:
             return None
         return {
-            "data_devices": self._mesh.n_devices,
-            "data_shards": self._mesh.shards,
-            "shard_clients": self._mesh.occupancy(),
+            "data_devices": cs._mesh.n_devices,
+            "data_shards": cs._mesh.shards,
+            "shard_clients": cs._mesh.occupancy(),
             "ici_reduce_seconds": round(
-                self.obs.timer_seconds("ici_reduce"), 6
+                cs.obs.timer_seconds("ici_reduce"), 6
             ),
-            "reshards": int(self.obs.counter_value("mesh_reshards")),
-            "faults": int(self.obs.counter_value("mesh_faults")),
+            "reshards": int(cs.obs.counter_value("mesh_reshards")),
+            "faults": int(cs.obs.counter_value("mesh_faults")),
             # row-sharded secure kernel stage (parallel/kernel_shard.py):
             # the last level's active shard count / the crawl's deepest
             # (None before any secure crawl).  The LAYOUT signal is the
@@ -1757,74 +1472,15 @@ class CollectorServer:
             # sharded crawl); kernel_gather_seconds is that gather's
             # DISPATCH time (the transfer itself completes lazily under
             # the level's later fetch), a supplement, not the detector
-            "kernel_shards": self.obs.gauge_value("kernel_shards"),
-            "kernel_shards_max": self.obs.gauge_max("kernel_shards"),
-            "kernel_gathers": int(self.obs.counter_value("kernel_gathers")),
+            "kernel_shards": cs.obs.gauge_value("kernel_shards"),
+            "kernel_shards_max": cs.obs.gauge_max("kernel_shards"),
+            "kernel_gathers": int(cs.obs.counter_value("kernel_gathers")),
             "kernel_gather_seconds": round(
-                self.obs.timer_seconds("kernel_gather"), 6
+                cs.obs.timer_seconds("kernel_gather"), 6
             ),
         }
 
-    def _ckpt_levels(self) -> list:
-        """Level stamps of this server's on-disk checkpoints, ascending
-        NUMERICALLY (the same ordering :meth:`_ckpt_prune` keeps by)."""
-        if self.ckpt_dir is None or not os.path.isdir(self.ckpt_dir):
-            return []
-        prefix = f"fhh_server{self.server_id}_l"
-        levels = []
-        for name in os.listdir(self.ckpt_dir):
-            if name.startswith(prefix) and name.endswith(".npz"):
-                try:
-                    levels.append(int(name[len(prefix):-4]))
-                except ValueError:
-                    continue
-        return sorted(levels)
-
-    def _ckpt_path(self, level: int) -> str:
-        # level-stamped: a torn checkpoint round (one server wrote level k,
-        # the other died first) must leave BOTH servers able to restore the
-        # same earlier level — the leader names the level, the file for it
-        # either exists on both or the stash was never advanced
-        return os.path.join(
-            self.ckpt_dir, f"fhh_server{self.server_id}_l{level}.npz"
-        )
-
-    def _ckpt_prune(self, keep: int = 2) -> None:
-        """Drop all but the newest ``keep`` checkpoint levels (the leader
-        only ever restores its last acknowledged stash, which is at most
-        one boundary behind the newest file)."""
-        prefix = f"fhh_server{self.server_id}_l"
-        found = []
-        for name in os.listdir(self.ckpt_dir):
-            if name.startswith(prefix) and name.endswith(".npz"):
-                try:
-                    found.append((int(name[len(prefix):-4]), name))
-                except ValueError:
-                    continue
-        found.sort()
-        # NB: found[:-keep] would be the EMPTY slice at keep=0 ([-0] == [0])
-        doomed = found[: len(found) - keep] if keep else found
-        for _, name in doomed:
-            os.remove(os.path.join(self.ckpt_dir, name))
-
-    def _ckpt_clear(self) -> None:
-        if self.ckpt_dir is not None and os.path.isdir(self.ckpt_dir):
-            self._ckpt_prune(keep=0)
-
-    def _keys_fp(self) -> np.ndarray:
-        """Cheap key identity for checkpoint/restore pairing: key_idx +
-        root seeds.  Unlike the driver's every-plane fingerprint this is
-        an OPERATIONAL check (did the leader re-upload the same batch it
-        crawled with), not a cryptographic one — the leader is trusted
-        with key halves by definition."""
-        h = hashlib.sha256()
-        # fhh-lint: disable=host-sync-in-hot-loop (checkpoint/restore identity check: once per checkpoint, not per level)
-        h.update(np.ascontiguousarray(np.asarray(self.keys.key_idx)))
-        # fhh-lint: disable=host-sync-in-hot-loop (as above)
-        h.update(np.ascontiguousarray(np.asarray(self.keys.root_seed)))
-        return np.frombuffer(h.digest(), np.uint8)
-
-    async def tree_checkpoint(self, req) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
+    async def tree_checkpoint(self, req, cs: CollectionSession | None = None) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the session's verb lock; sanitizer-validated)
         """Persist the crawl state AFTER the given level completed:
         frontier eval states + node liveness + client liveness + the
         state layout flag (planar Pallas vs interleaved XLA — a restore
@@ -1832,6 +1488,13 @@ class CollectorServer:
         leader re-uploads them on a restart — they are the bulk of the
         bytes and the leader already holds them).  Atomic tmp+rename so
         a crash mid-write never corrupts the previous checkpoint.
+
+        Session-namespaced: each collection writes into its own filename
+        namespace (sessions.CollectionSession.ckpt_prefix — the default
+        session keeps the legacy names) and every blob is STAMPED with
+        its collection key (``sess`` field), so a blob renamed across
+        namespaces refuses to restore instead of resurrecting another
+        tenant's tree.
 
         Malicious (sketch) mode checkpoints too: the blob carries the
         frontier-following sketch DPF states, the stored (yet-unopened)
@@ -1846,19 +1509,20 @@ class CollectorServer:
         double-counts admitted keys and the shed stream resumes
         seed-identically.  A server with pools but no frontier (between
         windows) may checkpoint too: the blob is then ingest-only."""
-        if self.ckpt_dir is None:
+        cs = cs if cs is not None else self._default()
+        if cs.ckpt_dir is None:
             raise RuntimeError(
                 "tree_checkpoint: no checkpoint dir configured "
                 "(start the server with FHH_CKPT_DIR set)"
             )
         ing_only = bool((req or {}).get("ingest_only"))
-        if self.frontier is None and not self._ingest_pools:
+        if cs.frontier is None and not cs._ingest_pools:
             raise RuntimeError("tree_checkpoint before tree_init")
-        if ing_only and not self._ingest_pools:
+        if ing_only and not cs._ingest_pools:
             raise RuntimeError("tree_checkpoint: no ingest pools to persist")
         level = int(req["level"])
-        if self.frontier is not None and not ing_only:
-            st = self.frontier.states
+        if cs.frontier is not None and not ing_only:
+            st = cs.frontier.states
             # ONE stacked fetch for the whole blob (device_get of the
             # pytree), not one sync per plane — through a remote-chip
             # tunnel each fetch is a full round trip
@@ -1866,220 +1530,54 @@ class CollectorServer:
                 "seed": st.seed,
                 "bit": st.bit,
                 "y_bit": st.y_bit,
-                "alive": self.frontier.alive,
+                "alive": cs.frontier.alive,
             }
-            if self._sketch is not None:
-                fetch["sk_state_seed"] = self._sketch_states.seed
-                fetch["sk_state_t"] = self._sketch_states.t
-                if self._sketch_pairs is not None:
-                    fetch["sk_pairs"] = self._sketch_pairs[0]
+            if cs._sketch is not None:
+                fetch["sk_state_seed"] = cs._sketch_states.seed
+                fetch["sk_state_t"] = cs._sketch_states.t
+                if cs._sketch_pairs is not None:
+                    fetch["sk_pairs"] = cs._sketch_pairs[0]
             blob = jax.device_get(fetch)
-            blob["alive_keys"] = np.asarray(self.alive_keys)
-            blob["planar"] = np.bool_(self._planar())
-            blob["keys_fp"] = self._keys_fp()
+            blob["alive_keys"] = np.asarray(cs.alive_keys)
+            blob["planar"] = np.bool_(cs.planar())
+            blob["keys_fp"] = cs.keys_fp()
         else:
             blob = {"ing_only": np.bool_(True)}
         blob["level"] = np.int64(level)
-        self._ingest_ckpt_fields(blob)
-        if self._sketch is not None:
-            blob["sk_pids"] = np.asarray(self._sketch_pids)
-            blob["sk_depth"] = np.int64(self._sketch_depth)
-            blob["sk_root"] = np.asarray(self._sketch_root, np.uint32)
+        # the session stamp: restore validates it against the restoring
+        # session BEFORE any state mutates (satellite of the PR-4
+        # validate-before-mutate contract)
+        blob["sess"] = np.str_(cs.key)
+        cs.ingest_ckpt_fields(blob)
+        if cs._sketch is not None:
+            blob["sk_pids"] = np.asarray(cs._sketch_pids)
+            blob["sk_depth"] = np.int64(cs._sketch_depth)
+            blob["sk_root"] = np.asarray(cs._sketch_root, np.uint32)
             blob["sk_digest"] = np.frombuffer(
-                self._ratchet_digest, np.uint8
+                cs._ratchet_digest, np.uint8
             )
-            if self._sketch_pairs is not None:
-                blob["sk_pairs_depth"] = np.int64(self._sketch_pairs[1])
+            if cs._sketch_pairs is not None:
+                blob["sk_pairs_depth"] = np.int64(cs._sketch_pairs[1])
                 blob["sk_pairs_last"] = np.bool_(
-                    self._sketch_pairs_field is F255
+                    cs._sketch_pairs_field is F255
                 )
-        path = self._ckpt_path(level)
+        path = cs.ckpt_path(level)
         tmp = f"{path}.tmp{os.getpid()}"
         with open(tmp, "wb") as f:
             np.savez(f, **blob)
         os.replace(tmp, path)
-        self._ckpt_prune()
-        self.obs.count("checkpoint_writes", level=level)
+        cs.ckpt_prune()
+        cs.obs.count("checkpoint_writes", level=level)
         obs.emit(
             "resilience.server_checkpoint",
             server=self.server_id,
+            collection=cs.key,
             level=level,
             path=path,
         )
         return {"level": level}
 
-    # verdict codes in the checkpoint blob: slot >= 0, -1 = appended in
-    # arrival order (no slot), -2 = reservoir-shed
-    _ING_APPEND, _ING_SHED = -1, -2
-
-    def _ingest_ckpt_fields(self, blob: dict) -> None:
-        """Flatten every live ingest pool into ``ing_*`` npz fields:
-        per window, the meta/counters row, the per-``sub_id`` verdict
-        table, the entry slot table (per-leaf concatenation + lengths),
-        the quota ledger, and the reservoir RNG state when the shed
-        sampler engaged."""
-        ws = sorted(self._ingest_pools)
-        if not ws:
-            return
-        blob["ing_windows"] = np.asarray(ws, np.int64)
-        for i, w in enumerate(ws):
-            p = self._ingest_pools[w]
-            blob[f"ing{i}_meta"] = np.array(
-                [w, int(p.sealed), p.keys, p.admitted_keys, p.shed_keys,
-                 p.rejected, len(p.entries), p.wa.subs, p.wa.keys,
-                 -1 if p.wa.sub_keys is None else p.wa.sub_keys,
-                 p.wa.pending_draws],
-                np.int64,
-            )
-            sub_ids, codes = [], []
-            for sid, resp in p.verdicts.items():
-                sub_ids.append(sid)
-                if resp.get("shed"):
-                    codes.append(self._ING_SHED)
-                elif resp.get("slot") is None:
-                    codes.append(self._ING_APPEND)
-                else:
-                    codes.append(int(resp["slot"]))
-            blob[f"ing{i}_sub_ids"] = np.array(sub_ids, dtype=str)
-            blob[f"ing{i}_sub_codes"] = np.array(codes, np.int64)
-            blob[f"ing{i}_lens"] = np.array(
-                [int(e[0].shape[0]) for e in p.entries], np.int64
-            )
-            n_leaf = len(p.entries[0]) if p.entries else 0
-            blob[f"ing{i}_nleaf"] = np.int64(n_leaf)
-            for j in range(n_leaf):
-                # entries are host arrays already (submit_keys converts)
-                blob[f"ing{i}_leaf{j}"] = np.concatenate(
-                    [e[j] for e in p.entries]
-                )
-            blob[f"ing{i}_clients"] = np.array(
-                list(p.wa.client_keys.keys()), dtype=str
-            )
-            blob[f"ing{i}_client_keys"] = np.array(
-                list(p.wa.client_keys.values()), np.int64
-            )
-            if p.wa.reservoir is not None:
-                blob[f"ing{i}_res"] = p.wa.reservoir.state()
-
-    def _ingest_validate(self, z: dict, path: str) -> list | None:
-        """Validate-before-mutate for the ``ing_*`` fields: parse every
-        window's record fully (shapes cross-checked) BEFORE any pool is
-        touched; a torn tail refuses loudly with live state intact.
-        Returns the parsed per-window records, or None when the blob
-        carries no ingest fields (a pre-streaming checkpoint)."""
-        if "ing_windows" not in z:
-            return None
-        parsed = []
-        # fhh-lint: disable=host-sync-in-hot-loop (checkpoint blob: host npz entries)
-        ws = np.asarray(z["ing_windows"], np.int64)  # checkpoint blob: host
-        for i, w in enumerate(ws):
-            req_keys = {f"ing{i}_meta", f"ing{i}_sub_ids", f"ing{i}_sub_codes",
-                        f"ing{i}_lens", f"ing{i}_nleaf"}
-            missing = req_keys - set(z)
-            if missing:
-                raise RuntimeError(
-                    f"tree_restore: checkpoint at {path} is missing ingest "
-                    f"fields {sorted(missing)} (truncated write?)"
-                )
-            meta = np.array(z[f"ing{i}_meta"], np.int64)
-            if meta.shape != (11,) or int(meta[0]) != int(w):
-                raise RuntimeError(
-                    f"tree_restore: checkpoint at {path} has a malformed "
-                    f"ingest meta row for window {int(w)}"
-                )
-            lens = np.array(z[f"ing{i}_lens"], np.int64)
-            n_leaf = int(z[f"ing{i}_nleaf"])
-            if lens.shape[0] != int(meta[6]):
-                raise RuntimeError(
-                    f"tree_restore: ingest window {int(w)} entry table is "
-                    f"torn ({lens.shape[0]} lengths vs {int(meta[6])} slots)"
-                )
-            leaves = []
-            for j in range(n_leaf):
-                key = f"ing{i}_leaf{j}"
-                if key not in z:
-                    raise RuntimeError(
-                        f"tree_restore: ingest window {int(w)} is missing "
-                        f"leaf {j} (truncated write?)"
-                    )
-                leaf = z[key]  # npz entries are host ndarrays
-                if leaf.shape[0] != int(lens.sum()):
-                    raise RuntimeError(
-                        f"tree_restore: ingest window {int(w)} leaf {j} "
-                        f"covers {leaf.shape[0]} keys, lengths sum to "
-                        f"{int(lens.sum())}"
-                    )
-                leaves.append(leaf)
-            sub_ids = z[f"ing{i}_sub_ids"]
-            codes = np.array(z[f"ing{i}_sub_codes"], np.int64)
-            if sub_ids.shape[0] != codes.shape[0]:
-                raise RuntimeError(
-                    f"tree_restore: ingest window {int(w)} verdict table "
-                    "is torn"
-                )
-            parsed.append({
-                "meta": meta,
-                "lens": lens,
-                "leaves": leaves,
-                "sub_ids": sub_ids,
-                "codes": codes,
-                "clients": np.array(z.get(f"ing{i}_clients", [])),
-                "client_keys": np.array(
-                    z.get(f"ing{i}_client_keys", []), np.int64
-                ),
-                "res": (
-                    np.array(z[f"ing{i}_res"], np.uint64)
-                    if f"ing{i}_res" in z
-                    else None
-                ),
-            })
-        return parsed
-
-    def _ingest_restore_apply(self, parsed: list) -> None:
-        """Rebuild the ingest pools from validated records (the mutation
-        half of the restore contract)."""
-        from ..native import Reservoir
-
-        self._ingest_pools.clear()
-        for rec in parsed:
-            meta = rec["meta"]
-            w = int(meta[0])
-            wa = self._admission.window(w)
-            pool = _WindowPool(w, wa)
-            pool.sealed = bool(meta[1])
-            pool.keys = int(meta[2])
-            pool.admitted_keys = int(meta[3])
-            pool.shed_keys = int(meta[4])
-            pool.rejected = int(meta[5])
-            wa.subs = int(meta[7])
-            wa.keys = int(meta[8])
-            wa.sub_keys = None if int(meta[9]) < 0 else int(meta[9])
-            wa.pending_draws = int(meta[10])
-            bounds = np.concatenate([[0], np.cumsum(rec["lens"])])
-            pool.entries = [
-                tuple(
-                    leaf[bounds[e]:bounds[e + 1]] for leaf in rec["leaves"]
-                )
-                for e in range(len(rec["lens"]))
-            ]
-            for sid, code in zip(rec["sub_ids"], rec["codes"]):
-                code = int(code)
-                if code == self._ING_SHED:
-                    resp = {"admitted": False, "shed": True, "window": w}
-                elif code == self._ING_APPEND:
-                    resp = {"admitted": True, "slot": None, "window": w}
-                else:
-                    resp = {"admitted": True, "slot": code, "window": w}
-                pool.verdicts[str(sid)] = resp
-            wa.client_keys = {
-                str(c): int(n)
-                for c, n in zip(rec["clients"], rec["client_keys"])
-            }
-            if rec["res"] is not None:
-                wa.reservoir = Reservoir.from_state(rec["res"])
-            self._ingest_pools[w] = pool
-
-    async def tree_restore(self, req) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
+    async def tree_restore(self, req, cs: CollectionSession | None = None) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the session's verb lock; sanitizer-validated)
         """Reload the :meth:`tree_checkpoint` for the level the leader
         names; returns the completed level so the leader re-runs from
         ``level + 1``.  Requires keys: either still held (transient
@@ -2088,18 +1586,20 @@ class CollectorServer:
         batch.
 
         Every validation runs BEFORE any state mutates: a mismatched
-        fingerprint, a truncated/corrupt npz, or a blob from a deeper
-        level than this key batch's tree must fail loudly and leave the
+        fingerprint, a truncated/corrupt npz, a blob from a deeper
+        level than this key batch's tree, or a blob STAMPED for a
+        different collection session must fail loudly and leave the
         server's live state exactly as it was.
 
         Streaming ingest pools restore alongside (``ing_*`` fields, same
         validate-before-mutate contract); an ingest-ONLY blob (written
         between windows, no frontier) restores just the pools and leaves
         the crawl state empty — ``window_load`` rebuilds it."""
-        if self.ckpt_dir is None:
+        cs = cs if cs is not None else self._default()
+        if cs.ckpt_dir is None:
             raise RuntimeError("tree_restore: no checkpoint dir configured")
         want_level = int(req["level"])
-        path = self._ckpt_path(want_level)
+        path = cs.ckpt_path(want_level)
         if not os.path.exists(path):
             raise RuntimeError(f"tree_restore: no checkpoint at {path}")
         try:
@@ -2113,6 +1613,16 @@ class CollectorServer:
                 f"tree_restore: corrupt or truncated checkpoint at {path} "
                 f"({type(e).__name__}: {e})"
             ) from e
+        if "sess" in z and str(z["sess"]) != cs.key:
+            # session-namespace stamp: a blob renamed (or copied) across
+            # collection namespaces must refuse — restoring another
+            # tenant's tree into this session would silently serve its
+            # heavy hitters under the wrong collection
+            raise RuntimeError(
+                f"tree_restore: checkpoint at {path} is stamped for "
+                f"collection {str(z['sess'])!r}, not {cs.key!r} "
+                "(renamed across session namespaces?)"
+            )
         if "ing_only" in z and bool(z["ing_only"]):
             # ingest-only blob: pools back, crawl state untouched-empty.
             # No key requirement — the keys ARE the pools.
@@ -2122,25 +1632,26 @@ class CollectorServer:
                     f"{want_level} but records level {int(z['level'])} "
                     "(renamed or tampered file)"
                 )
-            parsed = self._ingest_validate(z, path)
+            parsed = cs.ingest_validate(z, path)
             if parsed is None:
                 raise RuntimeError(
                     f"tree_restore: ingest-only checkpoint at {path} "
                     "carries no ingest pools (truncated write?)"
                 )
-            self._ingest_restore_apply(parsed)
-            self.obs.count("checkpoint_restores", level=want_level)
+            cs.ingest_restore_apply(parsed)
+            cs.obs.count("checkpoint_restores", level=want_level)
             obs.emit(
                 "resilience.server_restore",
                 server=self.server_id,
+                collection=cs.key,
                 level=want_level,
                 ingest_only=True,
             )
             return {"level": want_level}
-        if self.keys is None:
-            if not self.keys_parts:
+        if cs.keys is None:
+            if not cs.keys_parts:
                 raise RuntimeError("tree_restore before add_keys")
-            self._concat_keys()
+            cs.concat_keys()
         required = {"seed", "bit", "y_bit", "alive", "alive_keys", "level",
                     "planar", "keys_fp"}
         missing = required - set(z)
@@ -2149,13 +1660,13 @@ class CollectorServer:
                 f"tree_restore: checkpoint at {path} is missing fields "
                 f"{sorted(missing)} (truncated write?)"
             )
-        if not np.array_equal(z["keys_fp"], self._keys_fp()):
+        if not np.array_equal(z["keys_fp"], cs.keys_fp()):
             raise RuntimeError(
                 "tree_restore: checkpoint was written under a different "
                 "key batch — re-upload the original keys"
             )
         level = int(z["level"])
-        L = self.keys.cw_seed.shape[-2]
+        L = cs.keys.cw_seed.shape[-2]
         if level != want_level:
             raise RuntimeError(
                 f"tree_restore: checkpoint at {path} is stamped level "
@@ -2167,14 +1678,14 @@ class CollectorServer:
                 f"tree_restore: checkpoint level {level} is deeper than "
                 f"this key batch's tree (data_len={L}) — wrong collection"
             )
-        n = self.keys.cw_seed.shape[0]
+        n = cs.keys.cw_seed.shape[0]
         # fhh-lint: disable=host-sync-in-hot-loop (restore path: host npz entry, once per recovery)
         alive_keys = np.asarray(z["alive_keys"])
         if alive_keys.shape[0] != n:
             raise RuntimeError(
                 "tree_restore: checkpoint client count != key batch"
             )
-        has_sketch = bool(self._sketch_parts) or self._sketch is not None
+        has_sketch = bool(cs._sketch_parts) or cs._sketch is not None
         if has_sketch != ("sk_root" in z):
             raise RuntimeError(
                 "tree_restore: sketch material mismatch — the checkpoint "
@@ -2197,81 +1708,87 @@ class CollectorServer:
                 )
         # ingest pools validate with everything else (a torn ing_* tail
         # refuses before ANY state mutates); None = pre-streaming blob
-        parsed_ing = self._ingest_validate(z, path)
+        parsed_ing = cs.ingest_validate(z, path)
         # -- all checks passed: mutate ------------------------------------
         states = EvalState(
             seed=jax.device_put(z["seed"]),
             bit=jax.device_put(z["bit"]),
             y_bit=jax.device_put(z["y_bit"]),
         )
-        saved_planar, planar = bool(z["planar"]), self._planar()
+        saved_planar, planar = bool(z["planar"]), cs.planar()
         if saved_planar != planar:
             states = (
                 collect.to_interleaved(states)
                 if saved_planar
                 else collect.to_planar(states)
             )
-        self.alive_keys = alive_keys
-        self.frontier = collect.Frontier(
+        cs.alive_keys = alive_keys
+        cs.frontier = collect.Frontier(
             states=states, alive=jax.device_put(z["alive"])
         )
-        if self._mesh is not None:
+        if cs._mesh is not None:
             # re-shard from the host-side blob: the frontier lands
             # client-axis-sharded across whatever local devices are
             # live — this is the device-loss recovery primitive (a lost
             # device is re-covered by re-placement, not a server restart)
-            self.frontier = self._mesh.shard_frontier(self.frontier)
-        self._children = None
-        self._last_shares = None
-        self._shard_children.clear()
-        self._shard_last.clear()
-        self._shard_level = None
-        self._expand_ready.clear()
+            cs.frontier = cs._mesh.shard_frontier(cs.frontier)
+        cs._children = None
+        cs._last_shares = None
+        cs._shard_children.clear()
+        cs._shard_last.clear()
+        cs._shard_level = None
+        cs._expand_ready.clear()
         if has_sketch:
-            if self._sketch is None:
-                self._concat_sketch()
-            self._sketch_states = dpf.DpfEvalState(
+            if cs._sketch is None:
+                cs.concat_sketch()
+            cs._sketch_states = dpf.DpfEvalState(
                 seed=jax.device_put(z["sk_state_seed"]),
                 t=jax.device_put(z["sk_state_t"]),
             )
             # fhh-lint: disable=host-sync-in-hot-loop (restore path: host npz entries, once per recovery)
-            self._sketch_pids = np.asarray(z["sk_pids"])
-            self._sketch_depth = int(z["sk_depth"])
+            cs._sketch_pids = np.asarray(z["sk_pids"])
+            cs._sketch_depth = int(z["sk_depth"])
             # fhh-lint: disable=host-sync-in-hot-loop (as above)
-            self._sketch_root = np.asarray(z["sk_root"], np.uint32).copy()
+            cs._sketch_root = np.asarray(z["sk_root"], np.uint32).copy()
             # fhh-lint: disable=host-sync-in-hot-loop (as above)
-            self._ratchet_digest = np.asarray(
+            cs._ratchet_digest = np.asarray(
                 z["sk_digest"], np.uint8
             ).tobytes()
             if "sk_pairs" in z:
-                self._sketch_pairs = (
+                cs._sketch_pairs = (
                     jax.device_put(z["sk_pairs"]), int(z["sk_pairs_depth"])
                 )
-                self._sketch_pairs_field = (
+                cs._sketch_pairs_field = (
                     F255 if bool(z["sk_pairs_last"]) else FE62
                 )
             else:
-                self._sketch_pairs = None
-                self._sketch_pairs_field = None
+                cs._sketch_pairs = None
+                cs._sketch_pairs_field = None
         if parsed_ing is not None:
-            self._ingest_restore_apply(parsed_ing)
-        self.obs.count("checkpoint_restores", level=level)
+            cs.ingest_restore_apply(parsed_ing)
+        cs.obs.count("checkpoint_restores", level=level)
         obs.emit(
-            "resilience.server_restore", server=self.server_id, level=level
+            "resilience.server_restore",
+            server=self.server_id,
+            collection=cs.key,
+            level=level,
         )
         return {"level": level}
 
-    async def plane_reset(self, _req) -> bool:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
+    async def plane_reset(self, _req, cs: CollectionSession = None) -> bool:  # fhh-race: holds=_verb_lock (dispatched by _dispatch under the SERVER infra lock — the plane is shared infrastructure across sessions; sanitizer-validated)
         """Re-establish the server↔server data plane after a peer loss.
 
         Only the DIALER (server 0) acts: it drops the dead transport and
         redials under the shared backoff policy; the listener's side is
         re-accepted automatically (``_on_peer`` on its still-bound
-        listener).  Both sides re-run ``_plane_handshake`` on the fresh
-        connection — new sketch-challenge coin flip, new base-OT/IKNP
-        sessions — so the secure exchange is fully re-keyed."""
+        listener).  The plane is SHARED infrastructure: a reset bumps the
+        PlaneMux epoch, so EVERY session re-keys its channel (fresh coin
+        flip + base-OT) lazily at its next data-plane verb
+        (``_ensure_session_plane``) — a tenant that was mid-level fails
+        its wedged exchange loudly and its own supervisor re-runs the
+        level, exactly the per-tenant recovery story."""
         if self.server_id != 0:
-            return True  # listener: re-accept + re-handshake is automatic
+            return True  # listener: re-accept + re-key is automatic
         if self._peer_writer is not None and not self._peer_writer.is_closing():
             self._peer_writer.close()
         await self._dial_peer()
@@ -2279,17 +1796,17 @@ class CollectorServer:
         obs.emit("resilience.plane_reset", server=self.server_id)
         return True
 
-    async def plane_break(self, _req) -> bool:
+    async def plane_break(self, _req, cs: CollectionSession = None) -> bool:
         """Forcibly close this server's end of the peer data plane WITHOUT
         re-establishing it — the pipelined leader's quiesce primitive.  A
         faulted pipeline can leave a verb on EITHER server blocked in a
-        ``_swap`` recv while holding the verb lock (its span reached only
-        one server, so the peer's matching frame never comes); this verb
-        dispatches OUTSIDE the verb lock (see ``_dispatch``) precisely so
-        it can break that wedge: the close fails the blocked read loudly,
-        the wedged verb errors out and releases the lock, and the
-        leader's subsequent (locked) ``plane_reset`` re-keys the plane
-        cleanly."""
+        ``_swap`` recv while holding its session's verb lock (its span
+        reached only one server, so the peer's matching frame never
+        comes); this verb dispatches OUTSIDE the verb locks (see
+        ``_dispatch``) precisely so it can break that wedge: the close
+        kills the mux pump, every channel's blocked recv raises, the
+        wedged verbs error out and release their locks, and the leader's
+        subsequent ``plane_reset`` re-keys the plane cleanly."""
         w = self._peer_writer
         if w is not None and not w.is_closing():
             w.close()
@@ -2297,7 +1814,7 @@ class CollectorServer:
         obs.emit("resilience.plane_break", server=self.server_id)
         return True
 
-    async def warmup(self, req) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the verb lock; sanitizer-validated)
+    async def warmup(self, req, cs: CollectionSession | None = None) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the session's verb lock; sanitizer-validated)
         """Pre-compile the per-``f_bucket`` crawl programs so bucket
         recompiles stop billing into measured (or production) crawl time:
         for every requested bucket (and every shard-span size it implies
@@ -2306,12 +1823,19 @@ class CollectorServer:
         in-process OT session with the real key batch's shapes.  Touches
         no protocol state: the live OT sessions, frontier, and data plane
         are never involved, so warmup can run any time after ``add_keys``
-        (the leader calls it right after ``tree_init``).  Returns the
-        number of (bucket, span) shapes warmed."""
-        if self.keys is None:
-            if not self.keys_parts:
+        (the leader calls it right after ``tree_init``).
+
+        Multi-tenant: the compiled-program ladder is PROCESS-shared
+        (tenancy.WarmLadder) — a shape any session already warmed is
+        skipped (``ladder_hits`` in the response), so a new collection
+        on a warmed shape pays zero fresh compiles AND zero redundant
+        warm executions.  Returns the number of (bucket, span) shapes
+        warmed plus the ladder hits."""
+        cs = cs if cs is not None else self._default()
+        if cs.keys is None:
+            if not cs.keys_parts:
                 raise RuntimeError("warmup before add_keys")
-            self._concat_keys()
+            cs.concat_keys()
         buckets = sorted(
             {int(b) for b in (req or {}).get("f_buckets", []) if int(b) > 0}
         )
@@ -2328,7 +1852,7 @@ class CollectorServer:
         # authoritative — warmup always compiles the programs the LIVE
         # crawl will dispatch
         want_devices = (req or {}).get("data_shards")
-        have_shards = 1 if self._mesh is None else self._mesh.shards
+        have_shards = 1 if cs._mesh is None else cs._mesh.shards
         if want_devices is not None and int(want_devices) > 0:
             # the leader names a DEVICE budget; resolve it exactly like
             # this server resolved its own (visible-device cap, then
@@ -2336,7 +1860,7 @@ class CollectorServer:
             # identically-configured pairs never warn — only real
             # config skew does
             want_shards = smesh._largest_divisor_leq(
-                self.keys.cw_seed.shape[0],
+                cs.keys.cw_seed.shape[0],
                 smesh.resolve_data_devices(int(want_devices)),
             )
             if want_shards != have_shards:
@@ -2347,9 +1871,10 @@ class CollectorServer:
                     leader_data_shards=want_shards,
                     server_data_shards=have_shards,
                 )
-        L = self.keys.cw_seed.shape[-2]
+        L = cs.keys.cw_seed.shape[-2]
         shapes = 0
-        with self.obs.span("warmup"):
+        ladder_hits = 0
+        with cs.obs.span("warmup"):
             for b in buckets:
                 if (
                     self.cfg.secure_exchange
@@ -2369,42 +1894,71 @@ class CollectorServer:
                         )
                     }
                 for fb in sorted(sizes | {b}):
-                    self._warm_bucket(fb, L, ot_path)
-                    shapes += 1
+                    if self._warm_bucket(cs, fb, L, ot_path):
+                        shapes += 1
+                    else:
+                        ladder_hits += 1
                     # yield between compiles: each can take seconds, and
                     # the control socket must keep answering keepalives
                     await asyncio.sleep(0)
-        self.obs.count("warmup_shapes", shapes)
-        return {"shapes": shapes}
+        cs.obs.count("warmup_shapes", shapes)
+        if ladder_hits:
+            cs.obs.count("warmup_ladder_hits", ladder_hits)
+        return {"shapes": shapes, "ladder_hits": ladder_hits}
 
-    def _warm_bucket(self, fb: int, L: int, ot_path: str | None = None) -> None:
+    def _warm_key(self, cs: CollectionSession, fb: int, L: int,
+                  ot_path: str | None) -> tuple:
+        """Everything that feeds the identity of the compiled programs a
+        (bucket ``fb``, this session's key batch) crawl dispatches —
+        the WarmLadder's skip key.  Two sessions with the same batch
+        shape/config hit the same jit executables, so re-warming for
+        the second is pure waste."""
+        mesh_shards = 0 if cs._mesh is None else cs._mesh.shards
+        return (
+            "warm",
+            cs.keys.cw_seed.shape,  # client batch + dims + depth
+            fb,
+            L,
+            bool(self.cfg.secure_exchange),
+            bool(self.cfg.secure_whole_level),
+            str(ot_path or self.cfg.ot_path),
+            mesh_shards,
+            int(self.cfg.secure_kernel_shards),
+            cs.planar(),
+        )
+
+    def _warm_bucket(self, cs: CollectionSession, fb: int, L: int,
+                     ot_path: str | None = None) -> bool:
         """Compile (by running on throwaway inputs) every device program
         a crawl at frontier bucket ``fb`` will hit: expand with and
         without children, the trusted count reduction, and in secure
         mode the OT-extension + equality + b2a + share-sum chain for both
         FE62 (inner levels) and F255 (the leaf level).  Under the
         multi-chip mesh every stage warms with the SHARDED layout the
-        live crawl dispatches (keys are already client-axis-sharded, the
-        frontier pins interleaved, reductions go through the shard_map
-        psum kernels) — jit executables key on input shardings, so
-        warming unsharded twins would leave every live program cold."""
-        mesh = self._mesh
+        live crawl dispatches.  Returns False when the process-level
+        WarmLadder says some session already warmed this exact shape
+        (the compiled programs are in the process jit cache — a new
+        tenant on a warmed shape pays zero fresh compiles)."""
+        ladder_key = self._warm_key(cs, fb, L, ot_path)
+        if tenancy.warmed(ladder_key):
+            return False
+        mesh = cs._mesh
         if mesh is not None:
             fr = mesh.shard_frontier(
-                collect.tree_init(self.keys, fb, planar=False)
+                collect.tree_init(cs.keys, fb, planar=False)
             )
         else:
-            fr = collect.tree_init(self.keys, fb)
-        d = self.keys.cw_seed.shape[1]
+            fr = collect.tree_init(cs.keys, fb)
+        d = cs.keys.cw_seed.shape[1]
         lasts = (False, True) if L > 1 else (True,)
         for last in lasts:
             level = L - 1 if last else 0
             packed, _ = collect.expand_share_bits(
-                self.keys, fr, level, want_children=not last,
+                cs.keys, fr, level, want_children=not last,
                 use_pallas=False if mesh is not None else None,
             )
             if self.cfg.secure_exchange:
-                N = self.keys.cw_seed.shape[0]
+                N = cs.keys.cw_seed.shape[0]
                 ks = (
                     mesh.kernel_bind(
                         fb * (1 << d) * N, 2 * d,
@@ -2436,9 +1990,9 @@ class CollectorServer:
             else:
                 masks = collect.pattern_masks(d)
                 alive = (
-                    self.alive_keys
-                    if self.alive_keys is not None
-                    else np.ones(self.keys.cw_seed.shape[0], bool)
+                    cs.alive_keys
+                    if cs.alive_keys is not None
+                    else np.ones(cs.keys.cw_seed.shape[0], bool)
                 )
                 if mesh is not None:
                     # peer rows arrive as host numpy on the live path
@@ -2454,6 +2008,8 @@ class CollectorServer:
                             packed, packed, masks, alive, fr.alive
                         )
                     )
+        tenancy.mark_warmed(ladder_key)
+        return True
 
     # -- wiring ----------------------------------------------------------
 
@@ -2479,6 +2035,10 @@ class CollectorServer:
         "plane_break",  # pipelined-crawl quiesce (unlocked dispatch)
         "warmup",  # per-f_bucket compile warmup (no protocol state)
     )
+
+    # verbs that run under the SERVER infra lock instead of the calling
+    # session's: the peer data plane is shared across sessions
+    _SERVER_VERBS = ("plane_reset",)
 
     def _bind_session(self, req) -> _Session | None:  # fhh-race: atomic (serve-loop session table: create-or-attach + eviction never suspends; all connections share one event loop)
         """Create-or-attach the leader session named in a ``__hello__``.
@@ -2507,13 +2067,28 @@ class CollectorServer:
         sess.last_seen = time.monotonic()
         return sess
 
-    async def _dispatch(self, sess: _Session | None, req_id, verb, req):
+    async def _dispatch(self, sess: _Session | None,
+                        cs: CollectionSession | None, req_id, verb, req):
         """Run one verb AT MOST ONCE per (session, req_id): replays of a
         finished verb answer from the bounded response cache; replays of a
         verb still executing await the same execution.  Errors are
         responses too — a deterministic rejection must replay as the same
-        rejection, not as a second execution attempt."""
+        rejection, not as a second execution attempt.
+
+        ``cs`` is the collection session the connection bound at hello
+        (None = a legacy client that never said hello: the DEFAULT
+        session).  Per-collection verbs serialize on the SESSION's verb
+        lock — two collections' verbs interleave on the event loop,
+        which is the whole multi-tenant point — while plane verbs take
+        the server infra lock (the plane is shared)."""
         self.obs.count("verb_requests")  # denominator of the dedup rate
+        if cs is None:
+            with guards.unguarded(
+                "serve-loop session bind: event-loop-atomic by the "
+                "fhh-race atomic contract on SessionTable.get"
+            ):
+                cs = self._table.get()
+        cs.last_used = time.monotonic()
         if sess is not None:
             sess.last_seen = time.monotonic()
             if req_id in sess.cache:
@@ -2548,7 +2123,13 @@ class CollectorServer:
                     "unlocked fast-path verb: event-loop-atomic by the "
                     "fhh-race atomic contracts on add_keys/submit_keys"
                 ):
-                    resp = await getattr(self, verb)(req)
+                    resp = await getattr(self, verb)(req, cs)
+            elif verb in self._SERVER_VERBS:
+                # shared-plane verbs serialize on the SERVER lock: two
+                # tenants' concurrent plane_resets must not interleave
+                # redials
+                async with self._verb_lock:
+                    resp = await getattr(self, verb)(req, cs)
             else:
                 # frame-arrival expand stage: overlap a sharded crawl's
                 # device work with the span currently holding the lock
@@ -2556,9 +2137,9 @@ class CollectorServer:
                     "frame-arrival prefetch: event-loop-atomic by the "
                     "fhh-race atomic contract on _maybe_pre_expand"
                 ):
-                    self._maybe_pre_expand(verb, req)
-                async with self._verb_lock:
-                    resp = await getattr(self, verb)(req)
+                    self._maybe_pre_expand(cs, verb, req)
+                async with cs._verb_lock:
+                    resp = await getattr(self, verb)(req, cs)
         # fhh-lint: disable=broad-except (RPC boundary: EVERY failure
         # mode must surface to the caller as an error response — a
         # narrowed list would hang the leader on the first unlisted one)
@@ -2590,16 +2171,20 @@ class CollectorServer:
         request runs as its own task, so many in-flight add_keys batches
         deserialize and append while others are still on the wire.  Verbs
         that touch the data plane or mutate protocol state serialize on
-        ``_verb_lock``; responses carry the id so completion order is
-        free.
+        their session's verb lock; responses carry the id so completion
+        order is free.
 
         A ``__hello__`` frame (sent by the reconnecting client on every
-        connect) binds this connection to a leader session; all later
-        verbs on the connection go through that session's replay dedup
-        (:meth:`_dispatch`).  A client that never says hello gets the
-        legacy at-most-once-per-connection behavior."""
+        connect) binds this connection to a leader session AND to a
+        collection session (``collection`` field; absent = the default
+        collection); all later verbs on the connection go through that
+        session's replay dedup (:meth:`_dispatch`) and run against that
+        collection's state.  A client that never says hello gets the
+        legacy at-most-once-per-connection, default-collection
+        behavior."""
         write_lock = asyncio.Lock()
         sess: _Session | None = None
+        cs: CollectionSession | None = None
         self._ctl_writers.add(writer)
 
         async def respond(req_id, resp):
@@ -2619,7 +2204,9 @@ class CollectorServer:
                     raise
 
         async def handle(req_id, verb, req):
-            await respond(req_id, await self._dispatch(sess, req_id, verb, req))
+            await respond(
+                req_id, await self._dispatch(sess, cs, req_id, verb, req)
+            )
 
         tasks = set()
         try:
@@ -2629,14 +2216,42 @@ class CollectorServer:
                     count=lambda n: self.obs.count("control_bytes_recv", n),
                 )
                 if verb == "__hello__":
-                    with guards.unguarded(
-                        "serve-loop session bind: event-loop-atomic by "
-                        "the fhh-race atomic contract on _bind_session"
-                    ):
-                        sess = self._bind_session(req)
+                    try:
+                        with guards.unguarded(
+                            "serve-loop session bind: event-loop-atomic by "
+                            "the fhh-race atomic contracts on _bind_session "
+                            "and SessionTable.get"
+                        ):
+                            sess = self._bind_session(req)
+                            new_cs = self._table.get(
+                                (req or {}).get("collection")
+                            )
+                            if new_cs is not cs:
+                                # refcount the binding: a bound session
+                                # is never idle-evicted (see
+                                # CollectionSession.bound)
+                                if cs is not None:
+                                    cs.bound -= 1
+                                new_cs.bound += 1
+                            cs = new_cs
+                            if sess is not None:
+                                sess.collection = cs.key
+                    except (ValueError, RuntimeError) as e:
+                        # a refused collection (bad key, table at cap)
+                        # answers the hello with an error instead of
+                        # binding the connection to the wrong session
+                        await respond(
+                            req_id,
+                            {"__error__": f"{type(e).__name__}: {e}"},
+                        )
+                        continue
                     await respond(
                         req_id,
-                        {"boot_id": self._boot_id, "server_id": self.server_id},
+                        {
+                            "boot_id": self._boot_id,
+                            "server_id": self.server_id,
+                            "collection": cs.key,
+                        },
                     )
                     continue
                 if verb not in self._VERBS:
@@ -2658,10 +2273,10 @@ class CollectorServer:
             # plane is already lost and cancelling costs nothing.
             # A silently-dead peer (partition/power loss, no FIN/RST) is
             # surfaced by the data-plane socket's TCP keepalive (_keepalive,
-            # ~2 min): the blocked _swap recv then raises, the verb task
-            # finishes on its own, and is_closing() turns true — so this
-            # loop needs no wall-clock guess that could misfire on a LIVE
-            # peer running legitimately long verbs.
+            # ~2 min): the blocked recv then raises through the mux, the
+            # verb task finishes on its own, and is_closing() turns true —
+            # so this loop needs no wall-clock guess that could misfire on
+            # a LIVE peer running legitimately long verbs.
             pending = set(tasks)
             deadline = time.monotonic() + 1800  # generous overall backstop
             while pending:
@@ -2683,6 +2298,8 @@ class CollectorServer:
                     break
             writer.close()
             self._ctl_writers.discard(writer)
+            if cs is not None:
+                cs.bound -= 1  # connection gone: release the binding
 
     async def aclose(self) -> None:
         """Tear the whole server down — listeners, leader connections,
@@ -2699,6 +2316,7 @@ class CollectorServer:
         self._ctl_writers.clear()
         if self._peer_writer is not None and not self._peer_writer.is_closing():
             self._peer_writer.close()
+        self._plane.close()
 
     @staticmethod
     def _keepalive(writer: asyncio.StreamWriter) -> None:
@@ -2718,11 +2336,32 @@ class CollectorServer:
             if hasattr(socket, opt):
                 sock.setsockopt(socket.IPPROTO_TCP, getattr(socket, opt), val)
 
+    @staticmethod
+    async def _recv_plane_frame(reader):
+        """One framed data-plane read for the PlaneMux pump: returns
+        (framed byte size, frame).  Byte accounting happens in the mux's
+        route hook (the channel is only known after unpickling)."""
+        # fhh-lint: disable=unbounded-await (serve-loop read: the pump waits indefinitely for the next frame by design; liveness comes from the socket's TCP keepalive)
+        hdr = await reader.readexactly(_HDR.size)
+        (n,) = _HDR.unpack(hdr)
+        # fhh-lint: disable=unbounded-await (as above)
+        return n + _HDR.size, pickle.loads(await reader.readexactly(n))
+
+    def _attach_plane(self, reader, writer) -> None:
+        """Bind a fresh peer transport: keepalive on, mux pump attached
+        (failing every session's blocked recv from the OLD transport).
+        Sessions re-key their channels lazily (``_ensure_session_plane``
+        compares ``cs.plane_epoch`` to the mux epoch)."""
+        self._peer_reader, self._peer_writer = reader, writer
+        self._keepalive(writer)
+        self._plane.attach(reader, self._recv_plane_frame)
+
     async def _dial_peer(self) -> None:
         """Dial the peer data plane under the shared backoff policy (the
         reference's connect_with_retries_tcp, server.rs:235, upgraded from
-        fixed sleeps to exponential backoff + full jitter) and run the
-        session handshake on the fresh connection."""
+        fixed sleeps to exponential backoff + full jitter).  Per-session
+        channel handshakes (coin flip + base-OT) run lazily over the
+        fresh transport — see ``_ensure_session_plane``."""
         peer_host, peer_port = self._peer_addr
 
         async def dial():
@@ -2741,14 +2380,13 @@ class CollectorServer:
             raise ConnectionError(
                 f"peer data-plane unreachable at {peer_host}:{peer_port}: {e!r}"
             ) from e
-        self._peer_reader, self._peer_writer = r, w
-        self._keepalive(w)
-        await self._plane_handshake()
+        self._attach_plane(r, w)
 
     async def start(self, host: str, port: int, peer_host: str, peer_port: int):
         """Bring up the data plane FIRST (like the reference: GC mesh before
-        the RPC listener, server.rs:344-354), run the base-OT handshake if
-        the exchange is secure, then serve the leader."""
+        the RPC listener, server.rs:344-354), then serve the leader.  The
+        per-session secure handshakes (base-OT etc.) run lazily when each
+        collection first touches the plane."""
         self._peer_addr = (peer_host, peer_port)
         with self.obs.span("setup"):
             if self.server_id == 1:
@@ -2767,59 +2405,78 @@ class CollectorServer:
         return self._rpc_srv
 
     async def _on_peer(self, reader, writer):
-        self._peer_reader, self._peer_writer = reader, writer
-        self._keepalive(writer)
-        await self._plane_handshake()
+        self._attach_plane(reader, writer)
         self._peer_ready.set()
 
-    async def _plane_handshake(self):
-        """Session setup on the fresh peer connection: coin-flip a shared
-        sketch-challenge seed (each side contributes 16 random bytes; the
-        XOR is uniform if either is honest — and crucially NEVER a public
-        constant: a client that can predict the challenge r can forge a
-        passing sketch), then the base-OT setup when the exchange is
-        secure."""
-        mine = _secrets.token_bytes(16)
-        theirs = await self._swap(mine)
-        self._sketch_seed = np.frombuffer(
-            bytes(a ^ b for a, b in zip(mine, theirs)), dtype="<u4"
-        ).copy()
-        await self._setup_secure()
+    async def _ensure_session_plane(self, cs: CollectionSession) -> None:
+        """Key this session's data-plane channel against the CURRENT
+        transport: coin-flip the shared sketch-challenge seed and (in
+        secure mode) run the base-OT handshakes — all over the session's
+        OWN mux channel, so N sessions key up concurrently on one
+        socket.  Runs once per (session, plane epoch): a plane reset
+        bumps the mux epoch and every session lazily re-keys at its next
+        data-plane verb.  Callers hold the session's verb lock, so the
+        handshake can never interleave with the session's own exchanges;
+        both servers reach this from the same leader verb
+        (tree_init/tree_crawl*/sketch_verify via ``_both``), so the
+        channel's FIFO carries matching handshake frames."""
+        if cs.plane_epoch == self._plane.epoch and (
+            cs._ot is not None or not self.cfg.secure_exchange
+        ):
+            return
+        with cs.obs.span("plane_handshake"):
+            # coin flip: each side contributes 16 random bytes; the XOR
+            # is uniform if either is honest — and crucially NEVER a
+            # public constant (a client that can predict the challenge r
+            # can forge a passing sketch)
+            mine = _secrets.token_bytes(16)
+            theirs = await self._swap(cs, mine)
+            cs._sketch_seed = np.frombuffer(
+                bytes(a ^ b for a, b in zip(mine, theirs)), dtype="<u4"
+            ).copy()
+            await self._setup_secure(cs)
+        cs.plane_epoch = self._plane.epoch
+        obs.emit(
+            "plane.session_keyed",
+            severity="debug",
+            server=self.server_id,
+            collection=cs.key,
+            epoch=cs.plane_epoch,
+        )
 
-    async def _setup_secure(self):
-        """One-time base-OT setup seeding the IKNP extension (the ocelot
-        session init of collect.rs:454-461 — ~128 host-side Chou-Orlandi
-        OTs; all per-level OT volume then runs as device kernels).  TWO
-        sessions, one per garbling direction, so the leader can alternate
-        the garbler per level (the reference's ``gc_sender`` flip,
-        rpc.rs:20-23, leader.rs:204-210) and garbling cost splits across
-        the servers.  In session ``g`` server ``g`` is the OT-extension
-        sender and plays base-OT *receiver* with its secret ``s`` — the
-        standard IKNP role flip (ops/otext.py)."""
+    async def _setup_secure(self, cs: CollectionSession) -> None:
+        """One-time base-OT setup seeding this SESSION's IKNP extension
+        (the ocelot session init of collect.rs:454-461 — ~128 host-side
+        Chou-Orlandi OTs; all per-level OT volume then runs as device
+        kernels).  TWO sessions, one per garbling direction, so the
+        leader can alternate the garbler per level (the reference's
+        ``gc_sender`` flip, rpc.rs:20-23, leader.rs:204-210) and
+        garbling cost splits across the servers.  In session ``g``
+        server ``g`` is the OT-extension sender and plays base-OT
+        *receiver* with its secret ``s`` — the standard IKNP role flip
+        (ops/otext.py).  Per collection: two tenants' OT streams are
+        fully independent (independent secrets, independent cursors), so
+        their 2PC transcripts are bit-identical to solo runs by
+        construction."""
         if not self.cfg.secure_exchange:
             return
         for g in (0, 1):
             if self.server_id == g:  # extension sender <- base-OT receiver
                 s_bits = otext.fresh_s_bits()
-                a_msg = await self._dp_recv()
+                a_msg = await self._dp_recv(cs)
                 br = baseot.BaseOtReceiver(s_bits)
-                await self._dp_send(br.round1(a_msg))
-                self._ot_snd = otext.OtExtSender(s_bits, br.seeds())
+                await self._dp_send(cs, br.round1(a_msg))
+                cs._ot_snd = otext.OtExtSender(s_bits, br.seeds())
             else:  # extension receiver <- base-OT sender
                 bs = baseot.BaseOtSender()
-                await self._dp_send(bs.round1())
-                r_msgs = await self._dp_recv()
+                await self._dp_send(cs, bs.round1())
+                r_msgs = await self._dp_recv(cs)
                 s0, s1 = bs.seeds([baseot.decompress(m) for m in r_msgs])
-                self._ot_rcv = otext.OtExtReceiver(s0, s1)
-        self._ot = (self._ot_snd, self._ot_rcv)  # marker: secure plane live
-        self._sec_seed = np.frombuffer(
+                cs._ot_rcv = otext.OtExtReceiver(s0, s1)
+        cs._ot = (cs._ot_snd, cs._ot_rcv)  # marker: secure plane live
+        cs._sec_seed = np.frombuffer(
             _secrets.token_bytes(16), dtype="<u4"
         ).copy()
-
-
-# ---------------------------------------------------------------------------
-# Leader client
-# ---------------------------------------------------------------------------
 
 
 class ServerRestartedError(ConnectionError):
@@ -2859,8 +2516,13 @@ class CollectorClient:
         *,
         dial_policy: respolicy.RetryPolicy | None = None,
         budgets: respolicy.VerbBudgets | None = None,
+        collection: str | None = None,
     ):
         self._host, self._port = host, port
+        # the collection session this client's connections bind to on
+        # the server (multi-tenant wire keying; None/"" = the default
+        # collection — every single-tenant flow unchanged)
+        self.collection = collection or DEFAULT_COLLECTION
         self._r = self._w = None
         self._send_lock = asyncio.Lock()
         self._conn_lock = asyncio.Lock()
@@ -2967,9 +2629,21 @@ class CollectorClient:
             hello = await self._roundtrip(
                 self._next_id,
                 "__hello__",
-                {"session": self.session_id, "epoch": self.epoch},
+                {
+                    "session": self.session_id,
+                    "epoch": self.epoch,
+                    "collection": self.collection,
+                },
                 respolicy.Deadline(self.budgets.budget("__hello__")),
             )
+            if isinstance(hello, dict) and "__error__" in hello:
+                # the server refused the collection (bad key / session
+                # table at cap): NOT transport-shaped — retrying the
+                # dial cannot help, the caller must change its ask
+                raise RuntimeError(
+                    f"hello refused by {self._host}:{self._port}: "
+                    f"{hello['__error__']}"
+                )
             new_boot = hello.get("boot_id")
             old_boot, self.boot_id = self.boot_id, new_boot
             if self.epoch > 1:

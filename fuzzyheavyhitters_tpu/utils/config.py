@@ -142,6 +142,13 @@ class Config:
     # window being crawled + the window(s) still accruing); bounds
     # server memory against a runaway window id
     ingest_windows_retained: int = 4
+    # multi-tenant collection sessions (protocol/sessions.py): how many
+    # per-collection sessions one server keeps live at once.  Each
+    # session owns a full crawl state (frontier, keys, ingest pools,
+    # OT endpoints), so the bound is a memory bound; at the cap an IDLE
+    # session (nothing uploaded, no pools, not mid-verb) is evicted
+    # oldest-first and a new collection is otherwise refused loudly.
+    collection_sessions_max: int = 8
     # arm the fhh-race runtime sanitizer (utils/guards.py) on this
     # process's servers/drivers regardless of FHH_DEBUG_GUARDS — every
     # guarded-attribute access then asserts its owning lock is held by

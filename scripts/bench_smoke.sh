@@ -88,6 +88,16 @@ assert mc.get("whole_level_speedup_vs_gathered") is not None, (
     "whole_level_speedup_vs_gathered missing (sharded-vs-gathered "
     "kernel comparison): " + last[:300]
 )
+mt = doc.get("extra", {}).get("multitenant", {})
+assert mt.get("bit_identical_vs_solo"), (
+    "multitenant section (per-collection sessions: bit-identity of "
+    "every tenant vs its solo run) missing from the compact line: "
+    + last[:300]
+)
+assert "aggregate_clients_per_sec" in mt and "stall_fill_ratio" in mt, (
+    "multitenant section missing aggregate rate / stall-fill ratio: "
+    + last[:300]
+)
 print(
     "bench_smoke OK: "
     f"{doc['metric']}={doc['value']}, "
@@ -99,6 +109,8 @@ print(
     f"(rates={mc['secure_clients_per_sec']}), "
     f"kernel_shards={mc['kernel_shards']} "
     f"(speedup_vs_gathered={mc['whole_level_speedup_vs_gathered']}), "
+    f"multitenant_agg={mt['aggregate_clients_per_sec']} "
+    f"(fill_ratio={mt['stall_fill_ratio']}), "
     f"line={len(last)}B, elapsed={doc.get('budget', {}).get('elapsed_s')}s"
 )
 EOF

@@ -9,7 +9,10 @@
 #     the streaming-ingest suite (tests/test_ingest.py — admission
 #     control, flood/slowclient chaos, kill-mid-window recovery), AND
 #     the multi-chip suite (tests/test_multichip.py — sharded-vs-single
-#     bit-identity, device-loss re-shard recovery),
+#     bit-identity, device-loss re-shard recovery), AND the multi-tenant
+#     suite (tests/test_sessions.py — N=4 concurrent collections
+#     bit-identical to solo, per-session gate isolation, the
+#     flood-A + kill/restart-s1 tenant-isolation leg),
 #     INCLUDING the slow-marked multi-fault storm tier-1 skips
 #   - writes a JSON artifact ({passed, failed, duration_s, tests}) to $1
 #     (default: chaos_report.json); exits non-zero on any failure
@@ -27,7 +30,7 @@ report="$(mktemp)"
 
 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_resilience.py tests/test_mesh_chaos.py tests/test_ingest.py \
-    tests/test_multichip.py \
+    tests/test_multichip.py tests/test_sessions.py \
     -m "" -q \
     -p no:cacheprovider --junitxml="$report"
 rc=$?
@@ -40,6 +43,7 @@ rc=$?
 # the lock discipline is exactly the production one)
 JAX_PLATFORMS=cpu FHH_DEBUG_GUARDS=1 python -m pytest \
     "tests/test_resilience.py::test_e2e_chaos_recovery_bit_identical" \
+    "tests/test_sessions.py::test_tenant_isolation_flood_and_kill_restart_mid_crawl" \
     -q -p no:cacheprovider
 guards_rc=$?
 if [ $guards_rc -ne 0 ]; then

@@ -462,10 +462,16 @@ def test_guard_map_drift_pyproject_vs_runtime_twins():
     test), and the runtime twin tables the sanitizer arms.  This pins
     pyproject == runtime twins, so an attribute guarded statically is
     exactly the set asserted dynamically."""
+    from fuzzyheavyhitters_tpu.protocol import sessions as sessmod
+
     cfg = load_config(REPO)
     want = {
         f"CollectorServer.{a}": lk for a, lk in rpc._SERVER_GUARDS.items()
     }
+    want.update({
+        f"CollectionSession.{a}": lk
+        for a, lk in sessmod._SESSION_GUARDS.items()
+    })
     want.update({
         f"WindowedIngest.{a}": lk
         for a, lk in leader_rpc._INGEST_GUARDS.items()
@@ -620,12 +626,15 @@ def test_sanitizer_raises_on_unlocked_server_access():
     _dispatch's lock — and accepts the same verb with the lock held."""
     cfg = _cfg(debug_guards=True)
     s = rpc.CollectorServer(0, cfg)
+    cs = s._table.default()
 
     async def flow():
         with pytest.raises(guards.GuardViolation):
-            await s.reset({})  # bypasses _dispatch: lock not held
-        async with s._verb_lock:
-            assert await s.reset({})  # same verb, owned lock: clean
+            await s.reset({}, cs)  # bypasses _dispatch: lock not held
+        async with cs._verb_lock:
+            # same verb under the SESSION's owned lock: clean (verbs
+            # serialize per collection session, not per server)
+            assert await s.reset({}, cs)
 
     asyncio.run(flow())
 
