@@ -691,6 +691,18 @@ def bench_secure(n=1024, L=12, port=39831, shard_nodes=4, pipeline_depth=4):
         # of every phase; reset above cleared the warm run's accounting)
         rep = s0.obs.report()
         rep1 = s1.obs.report()
+        # per-level latency SLO of the timed headline run (obs.hist):
+        # both servers' fixed-bucket histograms merge bucket-wise
+        from fuzzyheavyhitters_tpu.obs.hist import Histogram
+
+        lv = Histogram.merged(
+            [s0.obs.hist("level_latency"), s1.obs.hist("level_latency")]
+        )
+        slo = {
+            "level_p50_ms": round(1000 * (lv.quantile(0.5) or 0.0), 2),
+            "level_p95_ms": round(1000 * (lv.quantile(0.95) or 0.0), 2),
+            "level_max_ms": round(1000 * lv.max, 2),
+        }
         # timed sharded+pipelined comparison (the round-6 headline);
         # the pipeline telemetry lives entirely on this leg's own fresh
         # leader registry (the whole-level legs emit none)
@@ -719,10 +731,10 @@ def bench_secure(n=1024, L=12, port=39831, shard_nodes=4, pipeline_depth=4):
             assert np.array_equal(res_w.counts, other.counts)
             assert np.array_equal(res_w.paths, other.paths)
         return (dt_w, dt_p, dt_s, dt_g, overlap, stalls,
-                int(res_w.paths.shape[0]), rep, rep1)
+                int(res_w.paths.shape[0]), rep, rep1, slo)
 
     (dt, dt_pipe, dt_seq, dt_gc, overlap_s, stalls, hitters, rep,
-     rep1) = asyncio.run(run())
+     rep1, slo) = asyncio.run(run())
     phases, ctrs = rep["phases"], rep["counters"]
     zero = {"seconds": 0.0, "total": 0}
     fss, gcot, fld = (
@@ -763,6 +775,9 @@ def bench_secure(n=1024, L=12, port=39831, shard_nodes=4, pipeline_depth=4):
         # the whole-level fused-kernel phase split + path of the timed
         # headline run — the ROADMAP's acceptance instrument
         "secure_kernel": kernel,
+        # per-level latency quantiles (obs.hist histograms, both servers
+        # merged) — the measurement campaign's SLO headline
+        "slo": slo,
         # whole-level vs the round-6 sharded+pipelined path, and the
         # garbled-circuit sequential oracle everything was asserted
         # bit-identical against
@@ -1459,6 +1474,23 @@ def bench_ingest(n=65536, L=12, chunk=256, port=39931, threshold=0.05):
         out["n_keys"] = n
         out["chunk_keys"] = chunk
         out["report_ingest"] = ing
+        # SLO quantiles of the streaming run (obs.hist): the window's
+        # seal-to-hitters latency (driver clock), the e2e admit latency
+        # (gate + mirror + backoffs), and the servers' per-level crawl
+        # latency — the always-on dashboard's first-class metrics
+        from fuzzyheavyhitters_tpu.obs.hist import Histogram
+
+        sh = wi.obs.hist("seal_to_hitters") or Histogram()
+        adm = wi.obs.hist("ingest_admit") or Histogram()
+        lv = Histogram.merged(
+            [s0.obs.hist("level_latency"), s1.obs.hist("level_latency")]
+        )
+        out["slo"] = {
+            "seal_to_hitters_p50_s": round(sh.quantile(0.5) or 0.0, 4),
+            "seal_to_hitters_p95_s": round(sh.quantile(0.95) or 0.0, 4),
+            "admit_p95_ms": round(1000 * (adm.quantile(0.95) or 0.0), 3),
+            "level_p95_ms": round(1000 * (lv.quantile(0.95) or 0.0), 2),
+        }
         for c in (c0, c1):
             await c.aclose()
         for s in (s0, s1):
@@ -1841,13 +1873,13 @@ _COMPACT_KEYS = {
     "secure_crawl": (
         "secure_clients_per_sec", "ms_per_level_e2e", "secure_kernel",
         "whole_level_speedup_vs_pipelined",
-        "sequential_clients_per_sec", "pipeline_speedup",
+        "sequential_clients_per_sec", "pipeline_speedup", "slo",
     ),
     # _PARTIAL's key for the same section (the partial-dump path)
     "secure": (
         "secure_clients_per_sec", "ms_per_level_e2e", "secure_kernel",
         "whole_level_speedup_vs_pipelined",
-        "sequential_clients_per_sec", "pipeline_speedup",
+        "sequential_clients_per_sec", "pipeline_speedup", "slo",
     ),
     "secure_device": (
         "secure_device_clients_per_sec", "secure_device_ms_per_level_fe62",
@@ -1858,7 +1890,7 @@ _COMPACT_KEYS = {
     "upload": ("upload_keys_per_sec",),
     "ingest": (
         "ingest_keys_per_sec", "concurrent_keys_per_sec", "windows",
-        "shed", "rejected", "bit_identical_vs_batch",
+        "shed", "rejected", "bit_identical_vs_batch", "slo",
     ),
     "multichip": (
         "secure_clients_per_sec", "data_shards", "ici_reduce_seconds",

@@ -147,6 +147,14 @@ class LintConfig:
         "fuzzyheavyhitters_tpu/protocol",
         "fuzzyheavyhitters_tpu/resilience",
     )
+    # span-discipline rule: modules where obs spans must be context
+    # managers and emit()/observe() telemetry must stay out of
+    # jit-traced bodies (the obs layer itself + its heaviest consumers)
+    span_modules: tuple = (
+        "fuzzyheavyhitters_tpu/protocol",
+        "fuzzyheavyhitters_tpu/obs",
+        "fuzzyheavyhitters_tpu/parallel",
+    )
     # fhh-race rules (analysis/concurrency.py): modules whose asyncio
     # lock discipline is analyzed interprocedurally — the server verb
     # plane, the driver/ingest plane, and the threading-locked obs/
@@ -286,6 +294,7 @@ def load_config(root: str | None = None, pyproject: str | None = None) -> LintCo
         "await_modules",
         "readback_modules",
         "queue_modules",
+        "span_modules",
         "race_modules",
         "default_paths",
     ):

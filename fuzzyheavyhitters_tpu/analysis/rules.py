@@ -1,6 +1,6 @@
 """The fhh-lint rule set, tuned to this codebase's invariants.
 
-Eleven rules over ten concerns (the broad-except/bare-print concern
+Twelve rules over eleven concerns (the broad-except/bare-print concern
 ships as two rules so suppressions and severities stay per-rule; the
 two interprocedural fhh-race rules live in :mod:`.concurrency` and are
 registered here):
@@ -61,6 +61,13 @@ registered here):
   into OOM — the exact failure class the admission-controlled front
   door exists to prevent; every buffer is bounded or carries an inline
   suppression proving it is bounded by construction.
+- ``span-discipline`` — obs spans (``reg.span(...)``) not used as
+  context managers (a never-entered span records nothing and reads as
+  if it instruments the code; an abandoned one dangles in the
+  heartbeat and the merged trace) and ``emit()``/``observe()``
+  telemetry inside jit-decorated bodies (runs at trace time: records
+  once per compile, never per execution).  Scope ``span_modules``:
+  protocol/, obs/, parallel/.
 - ``guarded-state-unlocked`` / ``stale-read-across-await`` — the
   fhh-race pair (:mod:`.concurrency`): interprocedural asyncio
   lock-discipline over the declared guard map
@@ -799,7 +806,72 @@ class UnboundedAwait(Rule):
 
 
 # ---------------------------------------------------------------------------
-# 9. unbounded-queue
+# 9. span-discipline
+# ---------------------------------------------------------------------------
+
+
+class SpanDiscipline(Rule):
+    """Telemetry-correctness pair for the obs layer (``span_modules``:
+    protocol/, obs/, parallel/):
+
+    1. ``reg.span(...)`` objects not used as ``with`` context managers —
+       a span context that is never entered/exited records NOTHING (no
+       timer, no trace event) while reading as if it instruments the
+       code around it; a span entered but abandoned dangles forever in
+       the heartbeat and the merged trace.  The one legitimate
+       split-enter/exit site (WindowedIngest's per-window ingest span)
+       carries an inline suppression with its justification.
+    2. ``emit()``/``observe()`` calls inside jit-decorated functions —
+       they run at TRACE time, once per compile, not once per
+       execution: the metric silently records compile counts, not run
+       counts (hoist the telemetry to the host-side caller)."""
+
+    name = "span-discipline"
+    default_severity = "error"
+
+    def check(self, mod: SourceModule, cfg):
+        if not _under_prefix(mod.relpath, cfg.span_modules):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "span"
+            ):
+                parent = mod.parent(node)
+                if not (
+                    isinstance(parent, ast.withitem)
+                    and parent.context_expr is node
+                ):
+                    yield (
+                        *_span(node),
+                        "span(...) created outside a with statement — a "
+                        "span context that never enters/exits records no "
+                        "timer and dangles in the heartbeat/trace (use "
+                        "`with reg.span(...):`, or suppress with a "
+                        "justification where enter/exit are explicitly "
+                        "managed)",
+                    )
+                continue
+            seg = last_segment(dotted_name(node.func))
+            if seg in ("emit", "observe"):
+                chain = mod.enclosing_functions(node)
+                jit_fn = next(
+                    (f for f in chain if _is_jit_decorated(f)), None
+                )
+                if jit_fn is not None:
+                    yield (
+                        *_span(node),
+                        f"telemetry call '{seg}(...)' inside jit-compiled "
+                        f"function '{jit_fn.name}' runs at trace time — "
+                        "it records once per COMPILE, never per "
+                        "execution (hoist it to the host-side caller)",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# 10. unbounded-queue
 # ---------------------------------------------------------------------------
 
 # buffer constructors and the kwarg that bounds each.  SimpleQueue has no
@@ -881,6 +953,7 @@ ALL_RULES: tuple[Rule, ...] = (
     ChunkedDeviceReadback(),
     UnboundedAwait(),
     UnboundedQueue(),
+    SpanDiscipline(),
     # the interprocedural fhh-race pair (analysis/concurrency.py)
     *RACE_RULES,
 )

@@ -228,6 +228,9 @@ def main() -> None:
     # phase/byte accounting up to the level it died in (plus the
     # heartbeat trail naming it).  The leader keeps the bare
     # $FHH_RUN_REPORT path; the servers claim .s0/.s1 siblings.
+    # Likewise for the distributed-trace ring (FHH_TRACE_DIR): the
+    # leader's segment is named for it, and exit_report flushes it.
+    obs.trace.claim_tag("leader")
     with obs.exit_report():
         asyncio.run(amain())
 

@@ -102,6 +102,10 @@ def main() -> None:
     # environment; the leader keeps the bare path, each server claims a
     # .s<id> sibling so the last exiter can't clobber the others' reports
     obs.claim_report_path(f"s{server_id}")
+    # ... and names its distributed-trace ring segment the same way
+    # (FHH_TRACE_DIR; `python -m fuzzyheavyhitters_tpu.obs.trace merge`
+    # folds all three processes' rings into one Perfetto timeline)
+    obs.trace.claim_tag(f"s{server_id}")
     # shared exit contract (obs.exit_report): SIGTERM -> SystemExit, so a
     # drained/killed server still leaves its run report (phase seconds,
     # data-plane bytes, fetch counts) + a heartbeat trail for the postmortem

@@ -19,6 +19,16 @@ platform warning):
   phase seconds, data-plane bytes sent/received, device-fetch counts,
   GC test counts, OT batch sizes, frontier/survivor sizes, checkpoint
   events — everything the registries accumulated, as one JSON document.
+- :mod:`.hist` — fixed-bucket latency histograms (log-spaced, mergeable
+  across registries/processes) feeding the ``slo`` sections of
+  ``status`` and the run report: per-level crawl latency, per-verb RPC
+  latency, ingest admit latency, window seal-to-hitters.
+- :mod:`.trace` — cross-process distributed tracing: the leader mints a
+  trace id per crawl/window, every verb carries a span id, and each
+  process appends Chrome-trace events to a JSONL ring under
+  ``FHH_TRACE_DIR``; ``python -m fuzzyheavyhitters_tpu.obs.trace merge``
+  emits one clock-corrected Perfetto timeline.  ``FHH_PROFILE`` adds
+  JAX profiler captures keyed to the same trace ids.
 
 Env knobs (all optional):
 
@@ -29,9 +39,16 @@ Env knobs (all optional):
   binaries default to 30 s when unset)
 - ``FHH_RUN_REPORT``: path; when set, the binaries write the end-of-run
   report there
+- ``FHH_TRACE_DIR``: directory; when set, every process appends trace
+  events there (off = zero-cost, like ``FHH_DEBUG_GUARDS``);
+  ``FHH_TRACE_RING`` bounds events per ring segment
+- ``FHH_PROFILE``: directory; wrap each crawl (or only the levels in
+  ``FHH_PROFILE_LEVELS=2,5``) in a ``jax.profiler`` capture
 """
 
+from . import trace
 from .heartbeat import start_heartbeat, stop_heartbeat
+from .hist import Histogram
 from .logs import configure as configure_logs, emit
 from .metrics import Registry, all_registries, default_registry
 from .report import (
@@ -44,6 +61,7 @@ from .report import (
 )
 
 __all__ = [
+    "Histogram",
     "Registry",
     "all_registries",
     "claim_report_path",
@@ -56,5 +74,6 @@ __all__ = [
     "run_report",
     "start_heartbeat",
     "stop_heartbeat",
+    "trace",
     "write_run_report",
 ]

@@ -22,6 +22,7 @@ import os
 import threading
 
 from . import logs, metrics
+from . import trace as tracemod
 
 
 class Heartbeat(threading.Thread):
@@ -50,6 +51,15 @@ class Heartbeat(threading.Thread):
                 level=sp.level,
                 elapsed_s=sp.elapsed(),
             )
+            # wedge markers in the merged trace: a heartbeat instant per
+            # active span puts "what was running" on the Perfetto
+            # timeline even when the process never exits cleanly
+            if tracemod.enabled():
+                tracemod.instant(
+                    "heartbeat", comp=reg.name,
+                    span=sp.name, level=sp.level,
+                    elapsed_s=round(sp.elapsed(), 3),
+                )
         if not active:
             logs.emit("heartbeat", idle=True)
 

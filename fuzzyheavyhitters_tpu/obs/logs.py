@@ -110,6 +110,17 @@ def emit(event: str, severity: str = "info", **fields) -> None:
         if _cfg["fmt"] == "json":
             rec = {"ts": round(time.time(), 3), "sev": severity, "event": event}
             rec.update({k: _plain(v) for k, v in fields.items()})
+            # correlate log lines with the distributed trace: when
+            # fhh-trace is on and this task runs under a trace context,
+            # the line carries the trace id (grep the JSONL for it to
+            # jump from a log event to the Perfetto timeline)
+            if "trace" not in rec:
+                from . import trace as _trace  # lazy: avoid import cycle
+
+                if _trace.enabled():
+                    tid = _trace.current_trace_id()
+                    if tid is not None:
+                        rec["trace"] = tid
             line = json.dumps(rec)
         else:
             kv = " ".join(

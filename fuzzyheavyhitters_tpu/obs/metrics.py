@@ -40,6 +40,9 @@ import threading
 import time
 import weakref
 
+from . import trace as _trace
+from .hist import Histogram
+
 
 class Span:
     """One active timing context (a stack frame of Registry.span).
@@ -75,14 +78,14 @@ _FINAL: "list[tuple[str, int, dict]]" = []  # fhh-guard: _FINAL=_GLOBAL_LOCK
 _FINAL_DROPPED = 0  # fhh-guard: _FINAL_DROPPED=_GLOBAL_LOCK
 
 
-def _retain_final(name: str, seq: int, counters, gauges, timers) -> None:
+def _retain_final(name: str, seq: int, counters, gauges, timers, hists) -> None:
     """weakref.finalize callback: the owner dropped its registry — keep
     the final snapshot so the end-of-run report still carries this
     component's accounting.  Receives the metric dicts (NOT the registry,
     which the finalizer must not pin); nothing mutates them once the
     owner is gone."""
     global _FINAL_DROPPED
-    snap = Registry._snapshot(counters, gauges, timers)
+    snap = Registry._snapshot(counters, gauges, timers, hists)
     with _GLOBAL_LOCK:
         _FINAL.append((name, seq, snap))
         if len(_FINAL) > _MAX_FINAL:
@@ -117,6 +120,7 @@ class Registry:
         self._counters: dict[str, dict] = {}
         self._gauges: dict[str, dict] = {}
         self._timers: dict[str, dict] = {}
+        self._hists: dict[str, Histogram] = {}
         self._spans: list[Span] = []
         with _GLOBAL_LOCK:
             # registration order breaks name ties deterministically (a
@@ -127,7 +131,7 @@ class Registry:
             _REGISTRIES.add(self)
         weakref.finalize(
             self, _retain_final, self.name, self.seq,
-            self._counters, self._gauges, self._timers,
+            self._counters, self._gauges, self._timers, self._hists,
         )
 
     # -- counters / gauges / timers --------------------------------------
@@ -160,6 +164,32 @@ class Registry:
             ent["last"] = value
             if level is not None:
                 ent["levels"][level] = value
+
+    def observe(self, name: str, seconds: float) -> None:
+        """Record one latency sample into histogram ``name`` (fixed
+        log-spaced buckets, obs.hist.Histogram — mergeable across
+        registries and processes).  The SLO shape counters/gauges
+        cannot express: p50/p95/p99 of per-level crawl latency,
+        per-verb RPC latency, seal-to-hitters."""
+        seconds = _num(seconds)
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram()
+            h.observe(seconds)
+
+    def hist(self, name: str) -> Histogram | None:
+        """A merged COPY of histogram ``name`` (callers may merge it
+        onward without racing live observes)."""
+        with self._lock:
+            h = self._hists.get(name)
+            return None if h is None else Histogram.merged([h])
+
+    def hists_summary(self) -> dict:
+        """{name: quantile summary} for every histogram — the ``status``
+        verb's live SLO section (no buckets: bounded response size)."""
+        with self._lock:
+            return {k: h.summary() for k, h in sorted(self._hists.items())}
 
     def timer_add(self, name: str, seconds: float, level: int | None = None) -> None:
         seconds = _num(seconds)
@@ -200,6 +230,7 @@ class Registry:
             self._counters.clear()
             self._gauges.clear()
             self._timers.clear()
+            self._hists.clear()
 
     def counter_value(self, name: str, level: int | None = None) -> float:
         with self._lock:
@@ -242,12 +273,14 @@ class Registry:
         """JSON-serializable snapshot.  Level keys become strings (JSON
         objects can't carry int keys); totals stay numbers."""
         with self._lock:
-            return self._snapshot(self._counters, self._gauges, self._timers)
+            return self._snapshot(
+                self._counters, self._gauges, self._timers, self._hists
+            )
 
     @staticmethod
-    def _snapshot(counters, gauges, timers) -> dict:
+    def _snapshot(counters, gauges, timers, hists=None) -> dict:
         str_levels = lambda d: {str(k): v for k, v in sorted(d.items())}
-        return {
+        out = {
             "counters": {
                 k: {"total": v["total"], "by_level": str_levels(v["levels"])}
                 for k, v in sorted(counters.items())
@@ -265,21 +298,33 @@ class Registry:
                 for k, v in sorted(timers.items())
             },
         }
+        if hists:
+            # key present only when histograms exist: pre-SLO consumers
+            # (and the reset-to-empty contract) see the exact old shape
+            out["hists"] = {
+                k: h.snapshot() for k, h in sorted(hists.items())
+            }
+        return out
 
 
 class _SpanCtx:
-    __slots__ = ("_reg", "_name", "_level", "_span")
+    __slots__ = ("_reg", "_name", "_level", "_span", "_trace")
 
     def __init__(self, reg: Registry, name: str, level: int | None):
         self._reg, self._name, self._level = reg, name, level
 
     def __enter__(self) -> Span:
         self._span = Span(self._name, self._level)
+        # distributed tracing (obs.trace): under an active trace context
+        # this span records as a child event in the per-process ring —
+        # one enabled() flag read when tracing is off (the pinned
+        # zero-overhead contract, like FHH_DEBUG_GUARDS)
+        self._trace = _trace.span_begin() if _trace.enabled() else None
         with self._reg._lock:
             self._reg._spans.append(self._span)
         return self._span
 
-    def __exit__(self, *exc) -> None:
+    def __exit__(self, exc_type, exc, tb) -> None:
         dt = self._span.seconds = self._span.elapsed()
         with self._reg._lock:
             # remove THIS span (not blindly the top): an exception may
@@ -288,6 +333,14 @@ class _SpanCtx:
                 self._reg._spans.remove(self._span)
             except ValueError:
                 pass
+        if self._trace is not None:
+            # a span unwound by an exception (a severed data plane
+            # failing a mid-exchange verb) records error=true instead of
+            # dangling open in the merged trace
+            _trace.span_end(
+                self._trace, self._name, self._reg.name,
+                level=self._span.level, error=exc_type is not None,
+            )
         self._reg.timer_add(self._name, dt, self._level)
 
 

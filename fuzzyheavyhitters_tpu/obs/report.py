@@ -79,6 +79,8 @@ import signal
 import time
 
 from . import metrics
+from . import trace as tracemod
+from .hist import Histogram
 
 SCHEMA = "fhh-run-report/1"
 
@@ -138,9 +140,56 @@ def run_report(registries=None) -> dict:
     sess = _sessions_summary(out)
     if sess is not None:
         doc["sessions"] = sess
+    slo = _slo_summary(out)
+    if slo is not None:
+        doc["slo"] = slo
     if dropped:
         doc["dropped_registries"] = dropped
     return doc
+
+
+def _slo_summary(registries: dict) -> dict | None:
+    """Cross-registry SLO rollup: every latency histogram
+    (obs.hist.Histogram — fixed buckets, so same-named histograms merge
+    across the leader, both servers, and every per-session registry by
+    summing bucket counts) reduced to p50/p95/p99 + max.  Per-verb RPC
+    latencies (``rpc:<verb>`` histograms on the servers) fold into a
+    ``verbs`` sub-table; everything else (``level_latency``,
+    ``seal_to_hitters``, ``ingest_admit``) is a top-level metric with a
+    ``by_registry`` breakdown so the merged count's multiplicity (each
+    server observes every level once) stays visible.  Chip-profiler
+    captures (FHH_PROFILE) ride along under ``profile`` with the trace
+    ids they were taken in.  Present only when some histogram (or
+    capture) exists — pre-SLO runs omit the section entirely."""
+    merged: dict = {}
+    by_reg: dict = {}
+    for name, snap in registries.items():
+        for hname, hsnap in (snap.get("hists") or {}).items():
+            h = Histogram.from_snapshot(hsnap)
+            if hname in merged:
+                merged[hname].merge(h)
+            else:
+                merged[hname] = h
+            by_reg.setdefault(hname, {})[name] = {
+                k: v for k, v in hsnap.items() if k != "buckets"
+            }
+    captures = tracemod.profile_captures()
+    if not merged and not captures:
+        return None
+    out: dict = {}
+    verbs: dict = {}
+    for hname in sorted(merged):
+        row = merged[hname].summary()
+        if hname.startswith("rpc:"):
+            verbs[hname.split(":", 1)[1]] = row
+            continue
+        row["by_registry"] = by_reg.get(hname, {})
+        out[hname] = row
+    if verbs:
+        out["verbs"] = verbs
+    if captures:
+        out["profile"] = captures
+    return out
 
 
 def _recovery_summary(registries: dict) -> dict | None:
@@ -474,6 +523,15 @@ def _sessions_summary(registries: dict) -> dict | None:
             {"crawl_seconds": 0.0, "levels": 0, "ingest_admitted": 0,
              "data_bytes": 0},
         )
+        # heartbeat-gap instrument: the server stamps a per-session
+        # last_progress_ts gauge at every verb completion, so a wedged
+        # tenant is visible from the report (and live from ``status``)
+        # without reading logs — the age here is "as of report time"
+        g = snap.get("gauges", {}).get("last_progress_ts")
+        if g is not None and g.get("last") is not None:
+            row["last_progress_s"] = round(
+                max(0.0, time.time() - float(g["last"])), 3
+            )
         phases = snap.get("phases", {})
         for ph in ("fss", "gc_ot", "field"):
             t = phases.get(ph)
@@ -558,3 +616,4 @@ def exit_report(heartbeat_default_s: float = 30.0):
         yield
     finally:
         maybe_write_run_report()
+        tracemod.flush()  # the trace ring survives the exit too

@@ -19,6 +19,7 @@ import numpy as np
 
 from .. import obs as obsmod
 from ..obs import metrics as obsmetrics
+from ..obs import trace as obstrace
 from ..ops.fields import F255, FE62
 from ..ops.ibdcf import IbDcfKeyBatch
 from ..resilience import policy as respolicy
@@ -450,6 +451,15 @@ class RpcLeader:
         return counts[parent[:n_alive], pattern[:n_alive]], alive_after_verify
 
     async def run(self, nreqs: int) -> CrawlResult:
+        # one distributed trace per crawl (obs.trace): every verb this
+        # leader issues below carries the trace id, so both servers'
+        # spans land in ONE merged timeline.  FHH_PROFILE additionally
+        # wraps the crawl in a jax.profiler capture (per-level captures
+        # are the servers' FHH_PROFILE_LEVELS hook).
+        with obstrace.root("crawl"), obstrace.profile_capture("crawl"):
+            return await self._run(nreqs)
+
+    async def _run(self, nreqs: int) -> CrawlResult:
         cfg = self.cfg
         d, L = cfg.n_dims, cfg.data_len
         await self._both("tree_init", {"root_bucket": self.min_bucket})
@@ -460,10 +470,13 @@ class RpcLeader:
         counts_kept = np.zeros(0, np.uint32)
         alive_before_leaf = None  # liveness after the latest verify
         for level in range(L):
-            with self.obs.span("level", level=level):
+            with self.obs.span("level", level=level) as sp_level:
                 counts_kept, alive = await self._run_one_level(
                     level, nreqs, thresh
                 )
+            # leader-side per-level latency histogram (SLO surface:
+            # p50/p95 in the run report's slo section + the bench line)
+            self.obs.observe("level_latency", sp_level.seconds)
             if alive is not None:
                 alive_before_leaf = alive
             if counts_kept is None:
@@ -663,6 +676,27 @@ class RpcLeader:
         second opening.  Checkpointing degrades gracefully: servers
         without a checkpoint dir disable it (recovery then means
         restart-from-scratch), keeping supervision usable everywhere."""
+        # one distributed trace per supervised crawl — recovery waves,
+        # restores, and the re-run levels all land in the SAME timeline
+        with obstrace.root("crawl"), obstrace.profile_capture("crawl"):
+            return await self._run_supervised(
+                nreqs, keys0, keys1, sketch0, sketch1,
+                checkpoint_every=checkpoint_every,
+                max_recoveries=max_recoveries, warmup=warmup,
+            )
+
+    async def _run_supervised(
+        self,
+        nreqs: int,
+        keys0: IbDcfKeyBatch,
+        keys1: IbDcfKeyBatch,
+        sketch0=None,
+        sketch1=None,
+        *,
+        checkpoint_every: int = 8,
+        max_recoveries: int = 4,
+        warmup: bool = False,
+    ) -> CrawlResult:
         cfg = self.cfg
         d, L = cfg.n_dims, cfg.data_len
         if cfg.malicious and sketch0 is None:
@@ -727,10 +761,11 @@ class RpcLeader:
         level = 0
         while level < L:
             try:
-                with self.obs.span("level", level=level):
+                with self.obs.span("level", level=level) as sp_level:
                     counts_kept, alive = await self._run_one_level(
                         level, nreqs, thresh
                     )
+                self.obs.observe("level_latency", sp_level.seconds)
                 if alive is not None:
                     alive_before_leaf = alive
                 if counts_kept is None:
@@ -930,6 +965,7 @@ class WindowedIngest:
                 "(fhh-race atomic contract on _ensure_span)"
             ):
                 w = self.window
+            # fhh-lint: disable=span-discipline (explicitly managed context: the ingest span opens at the first submit and _exit_span closes it at the seal boundary — a with-block cannot straddle the two call sites)
             self._span_ctx = self.obs.span("ingest", level=w)
             self._span_ctx.__enter__()
 
@@ -946,6 +982,8 @@ class WindowedIngest:
         (``admitted`` or ``shed``).  Raises
         :class:`IngestOverloadedError` when every attempt was rejected."""
         self._ensure_span()
+        t_admit = time.perf_counter()  # ingest-admit SLO clock (e2e:
+        # gate + mirror + every Overloaded backoff this submission ate)
         if sub_id is None:
             # unique per LOGICAL submission; reused across transport
             # replays and recovery re-submissions so the servers'
@@ -1049,6 +1087,7 @@ class WindowedIngest:
         else:
             # fhh-lint: disable=stale-read-across-await (deliberate snapshot, same contract as the shed branch: the admitted count labels the window this submission LANDED in — the id banked under the lock at gate time, not whatever window is current after the backoff awaits)
             self.obs.count("ingest_admitted", n_keys, level=w)
+        self.obs.observe("ingest_admit", time.perf_counter() - t_admit)
         return r0
 
     async def seal_window(self) -> dict:
@@ -1082,7 +1121,10 @@ class WindowedIngest:
                         f"window {w} pools diverged at seal: "
                         f"gate {r0} vs mirror {r1}"
                     )
-                self._sealed[w] = r0
+                # bank the DRIVER's seal instant with the stats: the
+                # start of this window's seal-to-hitters SLO clock
+                # (observed when crawl_window serves its hitters)
+                self._sealed[w] = dict(r0, sealed_at=time.time())
                 self.window = w + 1
                 break
         self._exit_span()
@@ -1122,7 +1164,18 @@ class WindowedIngest:
         transport loss / server restart the driver recovers ingest state
         (checkpoint restore + journal replay), reloads the window, and
         re-runs its crawl — results stay bit-exact because the frozen
-        pool is reconstructed exactly and the crawl is deterministic."""
+        pool is reconstructed exactly and the crawl is deterministic.
+
+        One distributed trace per WINDOW: the nested ``run()`` reuses
+        it, so window_load, every level, and the final reconstruction
+        share the window's trace id; the seal-to-hitters latency
+        (seal instant banked by :meth:`seal_window` -> hitters served
+        here) lands in the driver's ``seal_to_hitters`` histogram — the
+        first-class SLO the always-on dashboard reads."""
+        with obstrace.root("window"):
+            return await self._crawl_window(w, max_recoveries=max_recoveries)
+
+    async def _crawl_window(self, w: int, *, max_recoveries: int = 4):
         async with self._submit_lock:
             stats = self._sealed.get(w)
         if stats is None:
@@ -1161,6 +1214,15 @@ class WindowedIngest:
                     # a server still coming back up: the next loop turn
                     # re-probes (bounded by max_recoveries)
                     continue
+        # seal -> hitters served: the driver-side seal-to-hitters SLO
+        # observation (the servers observe their own copy at
+        # final_shares; both merge into the report's slo section)
+        # fhh-lint: disable=stale-read-across-await (deliberate snapshot: the SLO clock starts at the SEAL-time instant banked in the stats row — a sealed window's stats never mutate, and re-reading under the lock would return the identical row or None after the prune below)
+        sealed_at = stats.get("sealed_at")
+        if sealed_at is not None:
+            self.obs.observe(
+                "seal_to_hitters", max(0.0, time.time() - sealed_at)
+            )
         # the window is crawled: its journal, journaled-id set, and seal
         # stats (and any earlier) are done — bounded driver memory
         # mirrors the servers' bounded pools.  Under the submit lock:

@@ -87,6 +87,7 @@ from __future__ import annotations
 
 import asyncio
 import collections as _collections
+import contextlib
 import os
 import pickle
 import secrets as _secrets
@@ -99,6 +100,7 @@ import numpy as np
 
 from .. import obs
 from ..obs import metrics as obsmetrics
+from ..obs import trace as obstrace
 from ..ops import baseot, dpf, gc, otext
 from ..ops.fields import F255, FE62
 from ..ops.ibdcf import EvalState, IbDcfKeyBatch
@@ -305,7 +307,9 @@ class CollectorServer:
         # (collection, payload) frames, the pump routes receives
         self._peer_reader: asyncio.StreamReader | None = None
         self._peer_writer: asyncio.StreamWriter | None = None
-        self._plane = sessions.PlaneMux(route_count=self._plane_count)
+        self._plane = sessions.PlaneMux(
+            route_count=self._plane_count, tag=f"server{server_id}"
+        )
         self._peer_addr: tuple | None = None
         # resilience state: boot id (reconnect vs restart), per-leader-
         # session replay dedup, control writers for aclose
@@ -607,8 +611,13 @@ class CollectorServer:
 
     async def _dp_send(self, cs: CollectionSession, obj):
         cs.obs.count("data_msgs_sent")
+        # under fhh-trace the frame's session header carries this verb's
+        # (trace_id, span_id), so the peer's arrival instant parents
+        # under the sender's span in the merged timeline
+        hdr = obstrace.wire_tag() if obstrace.enabled() else None
+        frame = (cs.key, obj) if hdr is None else (cs.key, obj, hdr)
         await _send(
-            self._peer_writer, (cs.key, obj),
+            self._peer_writer, frame,
             count=lambda n: cs.obs.count("data_bytes_sent", n),
         )
 
@@ -790,6 +799,11 @@ class CollectorServer:
                 packed, peer, masks, cs.alive_keys, frontier.alive,
             )
         self._emit_level_phases(cs, level, sp_fss, sp_gc, sp_field)
+        # per-level crawl latency histogram (SLO surface): this PASS's
+        # three phases, not the registry total a re-run would inflate
+        cs.obs.observe(
+            "level_latency", sp_fss.seconds + sp_gc.seconds + sp_field.seconds
+        )
         cs.stash_children(level, shard, children)
         return counts
 
@@ -1030,6 +1044,9 @@ class CollectorServer:
                     count_field, vals, jnp.asarray(w),
                 )
         self._emit_level_phases(cs, level, sp_fss, sp_gc, sp_field)
+        cs.obs.observe(
+            "level_latency", sp_fss.seconds + sp_gc.seconds + sp_field.seconds
+        )
         cs.stash_children(level, shard, children)
         return shares
 
@@ -1118,16 +1135,21 @@ class CollectorServer:
         # channel (fresh coin flip + base-OT) before any wire I/O
         await self._ensure_session_plane(cs)
         if self.cfg.secure_exchange:
-            return await self._mesh_guard(
-                cs, level,
-                lambda: self._crawl_counts_secure(
-                    cs, level, FE62, garbler=int(req.get("garbler", 0)),
-                    shard=shard, ot_path=req.get("ot_path"),
-                ),
+            # chip-profiler hook: FHH_PROFILE + FHH_PROFILE_LEVELS=N,...
+            # wraps exactly this level's device work in a jax.profiler
+            # capture, recorded against the live trace id (obs.trace)
+            with obstrace.profile_capture("level", level=int(level)):
+                return await self._mesh_guard(
+                    cs, level,
+                    lambda: self._crawl_counts_secure(
+                        cs, level, FE62, garbler=int(req.get("garbler", 0)),
+                        shard=shard, ot_path=req.get("ot_path"),
+                    ),
+                )
+        with obstrace.profile_capture("level", level=int(level)):
+            counts = await self._mesh_guard(
+                cs, level, lambda: self._crawl_counts(cs, level, shard=shard)
             )
-        counts = await self._mesh_guard(
-            cs, level, lambda: self._crawl_counts(cs, level, shard=shard)
-        )
         # NB: trusted mode — both servers hold these plaintext counts; the
         # shared-seed mask below is a WIRE-FORMAT shim so the leader's
         # uniform v0 - v1 reconstruction works, not a secrecy mechanism
@@ -1151,19 +1173,23 @@ class CollectorServer:
         shard = self._parse_shard(req)
         await self._ensure_session_plane(cs)
         if self.cfg.secure_exchange:
-            shares = await self._mesh_guard(
-                cs, level,
-                lambda: self._crawl_counts_secure(
-                    cs, level, F255, last=True,
-                    garbler=int(req.get("garbler", 0)), shard=shard,
-                    ot_path=req.get("ot_path"),
-                ),
-            )
+            with obstrace.profile_capture("level", level=int(level)):
+                shares = await self._mesh_guard(
+                    cs, level,
+                    lambda: self._crawl_counts_secure(
+                        cs, level, F255, last=True,
+                        garbler=int(req.get("garbler", 0)), shard=shard,
+                        ot_path=req.get("ot_path"),
+                    ),
+                )
         else:
-            counts = await self._mesh_guard(
-                cs, level,
-                lambda: self._crawl_counts(cs, level, last=True, shard=shard),
-            )
+            with obstrace.profile_capture("level", level=int(level)):
+                counts = await self._mesh_guard(
+                    cs, level,
+                    lambda: self._crawl_counts(
+                        cs, level, last=True, shard=shard
+                    ),
+                )
             r = cs.mask_rows(level, shard, counts.shape[-1], f255=True)
             if self.server_id == 0:
                 c = np.zeros(counts.shape + (8,), np.uint32)
@@ -1258,6 +1284,14 @@ class CollectorServer:
         reconstruction (ref: rpc.rs:65, collect.rs:993-1004; tree paths
         live with the leader in this design, see protocol/collect.py)."""
         cs = cs if cs is not None else self._default()
+        if cs._window_seal_ts is not None:
+            # window seal -> hitters served: the server-visible half of
+            # the seal-to-hitters SLO (the driver observes its own
+            # leader-side copy in WindowedIngest.crawl_window)
+            cs.obs.observe(
+                "seal_to_hitters", max(0.0, time.time() - cs._window_seal_ts)
+            )
+            cs._window_seal_ts = None  # one observation per loaded window
         return {"server_id": self.server_id, "shares": cs._last_shares}
 
     # -- streaming ingest front door (ROADMAP "Streaming ingestion": the
@@ -1341,6 +1375,9 @@ class CollectorServer:
             pool = cs.ingest_pool(w)  # sealing an idle window is legal
         if not pool.sealed:
             pool.sealed = True
+            # the seal instant starts this window's seal-to-hitters SLO
+            # clock (observed at final_shares of the crawl that loads it)
+            pool.sealed_at = time.time()
             cs.obs.count("windows_sealed")
             obs.emit(
                 "ingest.window_sealed",
@@ -1372,6 +1409,7 @@ class CollectorServer:
             raise RuntimeError(f"window_load: window {w} admitted no keys")
         cs.keys_parts = [IbDcfKeyBatch(*e) for e in pool.entries]
         cs.clear_crawl_state()
+        cs._window_seal_ts = pool.sealed_at  # seal-to-hitters SLO clock
         for old in [k for k in cs._ingest_pools if k < w]:
             del cs._ingest_pools[old]
         obs.emit(
@@ -1401,6 +1439,10 @@ class CollectorServer:
         return {
             "boot_id": self._boot_id,
             "collection": cs.key,
+            # wall clock for the leader's trace clock-offset handshake
+            # (obs.trace: NTP-style midpoint against the caller's
+            # send/recv instants; piggybacked here and on __hello__)
+            "clock": round(time.time(), 6),
             "has_keys": cs.keys is not None or bool(cs.keys_parts),
             "has_frontier": cs.frontier is not None,
             "dedup_hits": int(self.obs.counter_value("dedup_hits")),
@@ -1418,6 +1460,10 @@ class CollectorServer:
             "mesh": self._mesh_status(cs),
             # multi-tenant rollup (sessions.SessionTable + tenancy)
             "sessions": self._sessions_status(),
+            # live SLO quantiles (obs.hist): per-level crawl latency,
+            # per-verb RPC latency, seal-to-hitters — p50/p95/p99 from
+            # the calling session's fixed-bucket histograms
+            "slo": cs.obs.hists_summary(),
         }
 
     def _sessions_status(self) -> dict:  # fhh-race: atomic (read-only rollup over the session table in one event-loop slice; per-session reads are point-in-time probes for an operator, not protocol state)
@@ -1445,6 +1491,12 @@ class CollectorServer:
                     "ckpt_levels": cs.ckpt_levels(),
                     "has_frontier": cs.frontier is not None,
                     "plane_epoch": cs.plane_epoch,
+                    # seconds since this session last COMPLETED a verb:
+                    # a wedged tenant shows a growing gap here while the
+                    # process-wide heartbeat only names the active one
+                    "last_progress_s": round(
+                        max(0.0, time.monotonic() - cs.last_progress), 3
+                    ),
                 }
         return {
             "count": len(self._table),
@@ -2109,54 +2161,102 @@ class CollectorServer:
             done = sess.inflight[req_id] = (
                 asyncio.get_event_loop().create_future()
             )
+        # distributed trace context: the request dict carries the
+        # leader's {"t": trace_id, "s": span_id} (stamped once per
+        # CollectorClient.call and replayed VERBATIM with the req_id),
+        # so every span the verb opens below records as a child of the
+        # leader's call.  Replays never reach this point for an
+        # already-executed verb (the dedup cache answered above), so a
+        # (trace_id, span_id) records exactly once per execution.
+        ttok = (
+            obstrace.activate((req or {}).get("trace"))
+            if obstrace.enabled() and isinstance(req, dict)
+            else None
+        )
+        t_verb = time.monotonic()
         try:
-            if verb in ("add_keys", "submit_keys", "plane_break"):
-                # add_keys/submit_keys: append-only, no awaits -> atomic;
-                # submit_keys MUST bypass the lock so ingest keeps
-                # flowing while a windowed crawl holds it (that
-                # concurrency is the whole point of the front door).
-                # plane_break MUST bypass it too: it exists to break a
-                # verb wedged on the data plane while HOLDING the lock
-                # (pipelined quiesce) — behind the lock it could never
-                # run.
-                with guards.unguarded(
-                    "unlocked fast-path verb: event-loop-atomic by the "
-                    "fhh-race atomic contracts on add_keys/submit_keys"
-                ):
-                    resp = await getattr(self, verb)(req, cs)
-            elif verb in self._SERVER_VERBS:
-                # shared-plane verbs serialize on the SERVER lock: two
-                # tenants' concurrent plane_resets must not interleave
-                # redials
-                async with self._verb_lock:
-                    resp = await getattr(self, verb)(req, cs)
-            else:
-                # frame-arrival expand stage: overlap a sharded crawl's
-                # device work with the span currently holding the lock
-                with guards.unguarded(
-                    "frame-arrival prefetch: event-loop-atomic by the "
-                    "fhh-race atomic contract on _maybe_pre_expand"
-                ):
-                    self._maybe_pre_expand(cs, verb, req)
-                async with cs._verb_lock:
-                    resp = await getattr(self, verb)(req, cs)
-        # fhh-lint: disable=broad-except (RPC boundary: EVERY failure
-        # mode must surface to the caller as an error response — a
-        # narrowed list would hang the leader on the first unlisted one)
-        except Exception as e:
-            obs.emit(
-                "verb.error", severity="warn", server=self.server_id,
-                verb=verb, error=f"{type(e).__name__}: {e}",
-            )
-            resp = {"__error__": f"{type(e).__name__}: {e}"}
-        except asyncio.CancelledError:
-            # drain-path cancellation: release any replay waiting on this
-            # execution, then propagate
-            if sess is not None:
-                sess.inflight.pop(req_id, None)
-                if not done.done():
-                    done.cancel()
-            raise
+            try:
+                # verb span: arrival-to-response on the session's
+                # registry — the trace parent of the phase spans inside,
+                # and (via the rpc:{verb} histogram below) the per-verb
+                # RPC-latency SLO.  An exception unwinding through it
+                # (a severed data plane mid-exchange) marks the trace
+                # span error=true instead of leaving it dangling.
+                # status is exempt: its sessions rollup reads every
+                # session's CURRENT span as "the phase", and a probe
+                # must not report itself.
+                span_ctx = (
+                    contextlib.nullcontext() if verb == "status"
+                    # fhh-lint: disable=span-discipline (bound to a name only so status can swap in a nullcontext; the `with` on the next line enters/exits it normally)
+                    else cs.obs.span(f"verb:{verb}")
+                )
+                with span_ctx:
+                    if verb in ("add_keys", "submit_keys", "plane_break"):
+                        # add_keys/submit_keys: append-only, no awaits ->
+                        # atomic; submit_keys MUST bypass the lock so
+                        # ingest keeps flowing while a windowed crawl
+                        # holds it (that concurrency is the whole point
+                        # of the front door).  plane_break MUST bypass
+                        # it too: it exists to break a verb wedged on
+                        # the data plane while HOLDING the lock
+                        # (pipelined quiesce) — behind the lock it could
+                        # never run.
+                        with guards.unguarded(
+                            "unlocked fast-path verb: event-loop-atomic "
+                            "by the fhh-race atomic contracts on "
+                            "add_keys/submit_keys"
+                        ):
+                            resp = await getattr(self, verb)(req, cs)
+                    elif verb in self._SERVER_VERBS:
+                        # shared-plane verbs serialize on the SERVER
+                        # lock: two tenants' concurrent plane_resets
+                        # must not interleave redials
+                        async with self._verb_lock:
+                            resp = await getattr(self, verb)(req, cs)
+                    else:
+                        # frame-arrival expand stage: overlap a sharded
+                        # crawl's device work with the span currently
+                        # holding the lock
+                        with guards.unguarded(
+                            "frame-arrival prefetch: event-loop-atomic "
+                            "by the fhh-race atomic contract on "
+                            "_maybe_pre_expand"
+                        ):
+                            self._maybe_pre_expand(cs, verb, req)
+                        async with cs._verb_lock:
+                            resp = await getattr(self, verb)(req, cs)
+            # fhh-lint: disable=broad-except (RPC boundary: EVERY failure
+            # mode must surface to the caller as an error response — a
+            # narrowed list would hang the leader on the first unlisted one)
+            except Exception as e:
+                obs.emit(
+                    "verb.error", severity="warn", server=self.server_id,
+                    verb=verb, error=f"{type(e).__name__}: {e}",
+                )
+                resp = {"__error__": f"{type(e).__name__}: {e}"}
+            except asyncio.CancelledError:
+                # drain-path cancellation: release any replay waiting on
+                # this execution, then propagate
+                if sess is not None:
+                    sess.inflight.pop(req_id, None)
+                    if not done.done():
+                        done.cancel()
+                raise
+        finally:
+            obstrace.deactivate(ttok)
+        # per-verb RPC latency histogram (SLO surface: status.slo +
+        # the run report's slo.verbs) and the per-session heartbeat-gap
+        # instrument: last_progress marks verb COMPLETION — a wedged
+        # tenant keeps bumping last_used at frame arrival while
+        # last_progress stalls, which is the visible signal.  status is
+        # exempt from BOTH (like the verb span): a probe is not
+        # progress — an operator polling a stalled tenant's collection
+        # must not reset the very gap the probe exists to read — and
+        # probe counts would flood the verbs latency table.
+        if verb != "status":
+            cs.obs.observe(f"rpc:{verb}", time.monotonic() - t_verb)
+            cs.last_progress = time.monotonic()
+            cs.obs.gauge("last_progress_ts", round(time.time(), 3))
         if sess is not None:
             sess.put(req_id, resp)
             sess.inflight.pop(req_id, None)
@@ -2251,6 +2351,8 @@ class CollectorServer:
                             "boot_id": self._boot_id,
                             "server_id": self.server_id,
                             "collection": cs.key,
+                            # trace clock-offset handshake (see status)
+                            "clock": round(time.time(), 6),
                         },
                     )
                     continue
@@ -2534,6 +2636,9 @@ class CollectorClient:
         self.session_id = _secrets.token_hex(8)
         self.epoch = 0  # successful connects; >1 means we have reconnected
         self.boot_id: str | None = None  # server identity from last hello
+        # trace clock-handshake component tag ("server0"/"server1"),
+        # learned from the hello's server_id
+        self._clock_tag: str | None = None
         self.dial_policy = dial_policy or respolicy.DIAL_POLICY
         self.budgets = budgets or respolicy.VerbBudgets()
         # control-plane byte accounting lands on the leader process's
@@ -2626,6 +2731,7 @@ class CollectorClient:
             # session handshake: bind this connection to our session (the
             # server arms replay dedup) and learn the server's boot id
             self._next_id += 1
+            t_hello = time.time()
             hello = await self._roundtrip(
                 self._next_id,
                 "__hello__",
@@ -2636,6 +2742,7 @@ class CollectorClient:
                 },
                 respolicy.Deadline(self.budgets.budget("__hello__")),
             )
+            self._note_clock(hello, t_hello)
             if isinstance(hello, dict) and "__error__" in hello:
                 # the server refused the collection (bad key / session
                 # table at cap): NOT transport-shaped — retrying the
@@ -2655,6 +2762,29 @@ class CollectorClient:
                     epoch=self.epoch,
                     restarted=bool(old_boot and old_boot != new_boot),
                 )
+
+    def _note_clock(self, resp, t_sent: float) -> None:
+        """Trace clock-offset handshake: a hello/status response carries
+        the server's wall clock — record the NTP-style midpoint offset
+        (server_clock - leader_clock) so ``obs.trace merge`` can place
+        both servers' spans on the leader's timeline.  No-op without
+        tracing or when the response carries no clock."""
+        if not obstrace.enabled() or not isinstance(resp, dict):
+            return
+        clock = resp.get("clock")
+        if clock is None:
+            return
+        sid = resp.get("server_id")
+        if sid is not None:
+            self._clock_tag = f"server{sid}"
+        if self._clock_tag is None:
+            return
+        t_recv = time.time()
+        obstrace.note_clock(
+            self._clock_tag,
+            float(clock) - (t_sent + t_recv) / 2.0,
+            t_recv - t_sent,
+        )
 
     async def _roundtrip(self, req_id, verb, req, deadline: respolicy.Deadline):
         """One send + response wait on the CURRENT transport (no retry —
@@ -2761,36 +2891,78 @@ class CollectorClient:
         deadline = self.budgets.deadline(verb)
         first_boot = self.boot_id
         payload = req or {}
+        # distributed trace: stamp this call's span id into the request
+        # ONCE — replays resend the identical {"t","s","p"} with the
+        # req_id, so the server records the span exactly once per
+        # execution (dedup by (trace_id, span_id), like req_ids).  The
+        # payload is COPIED before stamping: callers share one req dict
+        # across both servers' calls (RpcLeader._both), and each call
+        # owns its own span.
+        twire = obstrace.wire_ctx() if obstrace.enabled() else None
+        if twire is not None:
+            payload = dict(payload)
+            payload["trace"] = twire[0]
         self._next_id += 1
         req_id = self._next_id  # ONE id for the call's lifetime: replays
         resp = None             # reuse it so the server can dedup them
-        while True:
-            seen_epoch = self.epoch
-            try:
-                resp = await self._roundtrip(req_id, verb, payload, deadline)
-                break
-            except respolicy.TRANSIENT_ERRORS as e:
-                if deadline.expired():
-                    raise TimeoutError(
-                        f"verb {verb!r} exceeded its "
-                        f"{self.budgets.budget(verb):g}s budget "
-                        f"(last error: {type(e).__name__}: {e})"
-                    ) from e
-                self.obs.count("call_retries")
-                obs.emit(
-                    "resilience.call_retry",
-                    severity="debug",
-                    verb=verb,
-                    epoch=seen_epoch,
-                    error=f"{type(e).__name__}: {e}",
-                )
-                await self._ensure_connected(seen_epoch)
-                if first_boot is not None and self.boot_id != first_boot:
-                    raise ServerRestartedError(
-                        f"server {self._host}:{self._port} restarted while "
-                        f"{verb!r} was in flight — state lost, replay unsafe"
-                    ) from e
-        if isinstance(resp, dict) and "__error__" in resp:
+        retried = False
+        try:
+            while True:
+                seen_epoch = self.epoch
+                try:
+                    t_sent = time.time()
+                    resp = await self._roundtrip(
+                        req_id, verb, payload, deadline
+                    )
+                    if verb == "status" and not retried:
+                        # periodic clock-offset refresh rides the probe.
+                        # First-attempt responses only: a retried status
+                        # may be answered from the replay-dedup cache,
+                        # whose "clock" is the ORIGINAL execution's —
+                        # pairing it with this attempt's send/recv
+                        # instants would skew the offset by the whole
+                        # reconnect backoff.
+                        self._note_clock(resp, t_sent)
+                    break
+                except respolicy.TRANSIENT_ERRORS as e:
+                    retried = True
+                    if deadline.expired():
+                        raise TimeoutError(
+                            f"verb {verb!r} exceeded its "
+                            f"{self.budgets.budget(verb):g}s budget "
+                            f"(last error: {type(e).__name__}: {e})"
+                        ) from e
+                    self.obs.count("call_retries")
+                    obs.emit(
+                        "resilience.call_retry",
+                        severity="debug",
+                        verb=verb,
+                        epoch=seen_epoch,
+                        error=f"{type(e).__name__}: {e}",
+                    )
+                    await self._ensure_connected(seen_epoch)
+                    if first_boot is not None and self.boot_id != first_boot:
+                        raise ServerRestartedError(
+                            f"server {self._host}:{self._port} restarted "
+                            f"while {verb!r} was in flight — state lost, "
+                            "replay unsafe"
+                        ) from e
+        except BaseException:
+            if twire is not None:
+                # the call span closes error=true — a severed transport
+                # or blown budget never leaves it dangling in the trace
+                obstrace.call_event(verb, self.obs.name, twire[1], error=True)
+            raise
+        # a server-side failure travels as an __error__ RESPONSE: the
+        # call span must close error=true too (filtering the merged
+        # timeline by error has to surface server failures, not just
+        # transport ones)
+        server_err = isinstance(resp, dict) and "__error__" in resp
+        if twire is not None:
+            obstrace.call_event(
+                verb, self.obs.name, twire[1], error=server_err
+            )
+        if server_err:
             raise RuntimeError(f"server error on {verb}: {resp['__error__']}")
         return resp
 

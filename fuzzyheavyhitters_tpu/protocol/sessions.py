@@ -115,7 +115,7 @@ class _WindowPool:
 
     __slots__ = (
         "window", "wa", "entries", "verdicts", "keys",
-        "admitted_keys", "shed_keys", "rejected", "sealed",
+        "admitted_keys", "shed_keys", "rejected", "sealed", "sealed_at",
     )
 
     def __init__(self, window: int, wa: resadmission.WindowAdmission):
@@ -128,6 +128,10 @@ class _WindowPool:
         self.shed_keys = 0
         self.rejected = 0
         self.sealed = False
+        # wall-clock seal instant: the start of this window's
+        # seal-to-hitters SLO clock (observed at final_shares of the
+        # crawl that loads the window — protocol/rpc.py)
+        self.sealed_at: float | None = None
 
     def apply(self, sub_id: str, chunk: tuple,
               v: resadmission.Verdict) -> dict:
@@ -286,6 +290,11 @@ class CollectionSession:
         self.obs = obs
         self.ckpt_dir = ckpt_dir
         self.last_used = time.monotonic()
+        # heartbeat-gap instrument: when did this session last COMPLETE
+        # a verb (last_used marks arrival — a wedged verb advances
+        # last_used forever while last_progress stalls, which is exactly
+        # the signal status.sessions.per_session.last_progress_s carries)
+        self.last_progress = time.monotonic()
         # control connections currently bound to this session via
         # __hello__ (protocol/rpc.py increments at bind, decrements when
         # the connection closes): a session with live bindings is NEVER
@@ -331,6 +340,9 @@ class CollectionSession:
         # bucket, quotas, reservoir seed), so a flooding tenant exhausts
         # its own bucket and cannot starve another collection's window
         self._ingest_pools: dict = {}
+        # seal instant of the window the CURRENT crawl loaded (None =
+        # batch upload): final_shares observes seal-to-hitters from it
+        self._window_seal_ts: float | None = None
         self._admission = resadmission.AdmissionController(
             max_window_keys=cfg.ingest_window_keys,
             rate_keys_per_s=cfg.ingest_rate_keys_per_s,
@@ -381,6 +393,7 @@ class CollectionSession:
         self._sketch_root = None
         self._ratchet_digest = None
         self._ingest_pools.clear()  # a new collection's front door opens clean
+        self._window_seal_ts = None
         self.ckpt_clear()  # a new collection must not resume an old one's
         if reset_obs:  # fresh per-collection phase/byte/fetch accounting
             self.obs.reset()
@@ -746,6 +759,12 @@ class CollectionSession:
             )
             if p.wa.reservoir is not None:
                 blob[f"ing{i}_res"] = p.wa.reservoir.state()
+            if p.sealed_at is not None:
+                # the seal instant rides the checkpoint so a recovered
+                # window's seal-to-hitters SLO observation survives the
+                # restart (the replayed seal verb is a no-op on an
+                # already-sealed pool and must not restamp the clock)
+                blob[f"ing{i}_sealed_at"] = np.float64(p.sealed_at)
 
     @staticmethod
     def ingest_validate(z: dict, path: str) -> list | None:
@@ -819,6 +838,12 @@ class CollectionSession:
                     if f"ing{i}_res" in z
                     else None
                 ),
+                # optional (blobs from before the SLO clock omit it)
+                "sealed_at": (
+                    float(z[f"ing{i}_sealed_at"])
+                    if f"ing{i}_sealed_at" in z
+                    else None
+                ),
             })
         return parsed
 
@@ -834,6 +859,7 @@ class CollectionSession:
             wa = self._admission.window(w)
             pool = _WindowPool(w, wa)
             pool.sealed = bool(meta[1])
+            pool.sealed_at = rec.get("sealed_at")
             pool.keys = int(meta[2])
             pool.admitted_keys = int(meta[3])
             pool.shed_keys = int(meta[4])
@@ -968,7 +994,7 @@ class PlaneMux:
     # two servers' channel streams diverged — fail the plane loudly.
     MAX_DEPTH = 1024
 
-    def __init__(self, route_count=None):
+    def __init__(self, route_count=None, tag: str = "plane"):
         self.epoch = 0
         self._queues: dict[str, asyncio.Queue] = {}
         self._err: BaseException | None = None
@@ -976,6 +1002,10 @@ class PlaneMux:
         # (chan, nbytes) byte-accounting hook, resolved by the server to
         # the owning session's registry
         self._route_count = route_count
+        # trace component name for frame-arrival instants (fhh-trace):
+        # the server passes "server{id}" so the merged timeline can draw
+        # the peer's span -> this server's wire arrival
+        self.tag = tag
 
     def attach(self, reader, read_frame) -> int:
         """Bind the mux to a fresh transport: fail every waiter of the
@@ -1045,7 +1075,16 @@ class PlaneMux:
             raise ConnectionError(
                 f"data plane down: {item.err!r}"
             ) from item.err
-        return item
+        payload, hdr = item
+        if hdr is not None and obsmod.trace.enabled():
+            # the peer stamped its (trace, span) onto the frame's
+            # session header: an arrival instant parented under the
+            # SENDER's span ties the two servers' timelines together
+            obsmod.trace.instant(
+                "plane_recv", comp=self.tag,
+                trace_id=hdr[0], parent=hdr[1], chan=chan,
+            )
+        return payload
 
     async def _pump(self, reader, read_frame, epoch: int) -> None:
         """Route frames until the transport dies.  A pump outliving its
@@ -1057,10 +1096,14 @@ class PlaneMux:
                 nbytes, frame = await read_frame(reader)
                 if epoch != self.epoch:
                     return
-                chan, payload = frame
+                # frames are (collection, payload) — or, under fhh-trace,
+                # (collection, payload, (trace_id, span_id)): the session
+                # header grows the sender's trace context
+                chan, payload = frame[0], frame[1]
+                hdr = frame[2] if len(frame) > 2 else None
                 if self._route_count is not None:
                     self._route_count(chan, nbytes)
-                self._queue(chan).put_nowait(payload)
+                self._queue(chan).put_nowait((payload, hdr))
         except asyncio.CancelledError:
             raise
         # fhh-lint: disable=broad-except (transport boundary: EVERY pump failure — EOF, reset, a QueueFull divergence, a corrupt frame — must surface to the blocked receivers as a plane death)

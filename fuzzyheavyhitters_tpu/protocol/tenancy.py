@@ -32,6 +32,8 @@ from __future__ import annotations
 import asyncio
 import contextlib
 
+from ..obs import trace as obstrace
+
 
 class TenantScheduler:
     """FIFO device-turn scheduler + stall-fill accounting (module doc).
@@ -86,9 +88,18 @@ class TenantScheduler:
         """Sync context manager marking a session as blocked on the
         data plane (wraps the recv awaits in protocol/rpc.py)."""
         self._wire[key] = self._wire.get(key, 0) + 1
+        # distributed trace: the wire wait is THE gap a second tenant's
+        # device turn fills — record it as a child span of the active
+        # verb so the merged timeline shows the stall being filled
+        st = obstrace.span_begin() if obstrace.enabled() else None
         try:
             yield
         finally:
+            if st is not None:
+                obstrace.span_end(
+                    st, "wire_wait",
+                    self.obs.name if self.obs is not None else "server",
+                )
             n = self._wire.get(key, 1) - 1
             if n <= 0:
                 self._wire.pop(key, None)
@@ -118,21 +129,33 @@ class TenantScheduler:
 
 
 class _DeviceTurn:
-    __slots__ = ("_sched", "_key", "_count")
+    __slots__ = ("_sched", "_key", "_count", "_trace")
 
     def __init__(self, sched: TenantScheduler, key: str, count: bool = True):
         self._sched = sched
         self._key = key
         self._count = count
+        self._trace = None
 
     async def __aenter__(self):
+        # the span covers lock wait + dispatch: a long device_turn with
+        # a short dispatch IS the cross-tenant queueing the scheduler
+        # exists to make visible
+        self._trace = obstrace.span_begin() if obstrace.enabled() else None
         await self._sched._device_lock.acquire()
         if self._count:
             self._sched._note_turn(self._key)
         return self
 
-    async def __aexit__(self, *exc):
+    async def __aexit__(self, exc_type, exc, tb):
         self._sched._device_lock.release()
+        if self._trace is not None:
+            obs = self._sched.obs
+            obstrace.span_end(
+                self._trace, "device_turn",
+                obs.name if obs is not None else "server",
+                error=exc_type is not None,
+            )
         return False
 
 

@@ -236,6 +236,14 @@ class ChaosProxy:
                         direction=direction,
                         msg=msg_no,
                     )
+                    # fault events become trace instants: the injected
+                    # sever/blackhole shows up ON the merged timeline at
+                    # the exact frame it fired, next to the spans it
+                    # errored (obs.trace; no-op when tracing is off)
+                    obs.trace.instant(
+                        f"chaos.{fault.action}", comp=f"chaos:{self.link}",
+                        direction=direction, msg=msg_no,
+                    )
                     if fault.action == "sever":
                         self._sever_pair(pair)
                         return
@@ -393,6 +401,10 @@ class MeshChaos:
                 severity="debug",
                 action=f.action,
                 level=level,
+            )
+            # mesh faults are trace instants too (see ChaosProxy._pump)
+            obs.trace.instant(
+                f"chaos.mesh_{f.action}", comp="chaos:mesh", level=level,
             )
             if f.action == "delay":
                 time.sleep(f.ms / 1000.0)

@@ -21,6 +21,12 @@ cd "$(dirname "$0")/.."
 budget="${1:-600}"
 out="$(mktemp)"
 
+# distributed tracing on for the whole smoke run: every bench child
+# process appends to its own ring under $trace_dir, and the merged
+# Perfetto trace must VALIDATE afterwards (fhh-trace structural gate)
+trace_dir="$(mktemp -d)"
+export FHH_TRACE_DIR="$trace_dir"
+
 # 8 virtual host devices so the multichip section's 2- and 4-shard legs
 # run on a CPU host (same mesh the tier-1 suite exercises);
 # optimization_level=1 sidesteps XLA:CPU's pathological ChaCha-scan pass
@@ -36,6 +42,34 @@ if [ $rc -ne 0 ]; then
     echo "bench_smoke: bench.py exited rc=$rc" >&2
     tail -5 "$out.err" >&2
     rm -f "$out" "$out.err"
+    rm -rf "$trace_dir"
+    exit 1
+fi
+
+# merged trace must load AND validate: every parented event's parent
+# exists, no negative durations, clock offsets sane (obs/trace.py)
+if ! python -m fuzzyheavyhitters_tpu.obs.trace merge \
+        -d "$trace_dir" -o "$trace_dir/trace.json" > "$trace_dir/verdict.json"
+then
+    echo "bench_smoke: merged fhh-trace FAILED validation" >&2
+    tail -20 "$trace_dir/verdict.json" >&2
+    rm -f "$out" "$out.err"; rm -rf "$trace_dir"
+    exit 1
+fi
+if ! python - "$trace_dir/verdict.json" <<'EOF'
+import json, sys
+v = json.load(open(sys.argv[1]))
+assert v["ok"], v["errors"][:3]
+assert v["spans"] > 0, "tracing was on but no spans were recorded"
+assert v["traces"], "no trace ids minted (leaders should mint per crawl)"
+print(
+    f"bench_smoke trace OK: {v['spans']} spans, "
+    f"{len(v['traces'])} traces, components={v['components'][:6]}"
+)
+EOF
+then
+    echo "bench_smoke: trace verdict assertions FAILED" >&2
+    rm -f "$out" "$out.err"; rm -rf "$trace_dir"
     exit 1
 fi
 
@@ -62,9 +96,19 @@ assert "ot_path" in sk and all(
     "secure_kernel phase split (phase_otext/garble/eval/b2a + ot_path) "
     "missing from the compact line: " + last[:300]
 )
+slo = sc.get("slo", {})
+assert slo.get("level_p95_ms") is not None, (
+    "secure_crawl slo (p95 per-level latency, obs.hist histograms) "
+    "missing from the compact line: " + last[:300]
+)
 ing = doc.get("extra", {}).get("ingest", {})
 assert "ingest_keys_per_sec" in ing and ing.get("bit_identical_vs_batch"), (
     "ingest section (streaming front door: keys/sec + batch bit-identity) "
+    "missing from the compact line: " + last[:300]
+)
+islo = ing.get("slo", {})
+assert islo.get("seal_to_hitters_p95_s") is not None, (
+    "ingest slo (seal-to-hitters p95 — the windowed SLO headline) "
     "missing from the compact line: " + last[:300]
 )
 mc = doc.get("extra", {}).get("multichip", {})
@@ -111,9 +155,12 @@ print(
     f"(speedup_vs_gathered={mc['whole_level_speedup_vs_gathered']}), "
     f"multitenant_agg={mt['aggregate_clients_per_sec']} "
     f"(fill_ratio={mt['stall_fill_ratio']}), "
+    f"slo_level_p95_ms={slo['level_p95_ms']}, "
+    f"seal_to_hitters_p95_s={islo['seal_to_hitters_p95_s']}, "
     f"line={len(last)}B, elapsed={doc.get('budget', {}).get('elapsed_s')}s"
 )
 EOF
 rc=$?
 rm -f "$out" "$out.err"
+rm -rf "$trace_dir"
 exit $rc

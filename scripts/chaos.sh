@@ -51,7 +51,27 @@ if [ $guards_rc -ne 0 ]; then
     rc=1
 fi
 
-python - "$report" "$artifact" "$guards_rc" <<'EOF'
+# fhh-trace stage: re-run one e2e chaos-recovery leg with distributed
+# tracing ON, then merge + structurally validate the trace — a recovery
+# wave (reconnect replays, plane resets, level re-runs) must still
+# produce a parent-consistent single-trace timeline (obs/trace.py)
+trace_dir="$(mktemp -d)"
+JAX_PLATFORMS=cpu FHH_TRACE_DIR="$trace_dir" python -m pytest \
+    "tests/test_resilience.py::test_e2e_chaos_recovery_bit_identical" \
+    -q -p no:cacheprovider
+trace_rc=$?
+if [ $trace_rc -eq 0 ]; then
+    python -m fuzzyheavyhitters_tpu.obs.trace merge \
+        -d "$trace_dir" -o "$trace_dir/trace.json" > /dev/null \
+        || trace_rc=$?
+fi
+if [ $trace_rc -ne 0 ]; then
+    echo "chaos suite: traced e2e leg / trace validation FAILED" >&2
+    rc=1
+fi
+rm -rf "$trace_dir"
+
+python - "$report" "$artifact" "$guards_rc" "$trace_rc" <<'EOF'
 import json, sys
 import xml.etree.ElementTree as ET
 
@@ -74,13 +94,15 @@ doc = {
     "skipped": sum(t["outcome"] == "skipped" for t in tests),
     "duration_s": round(float(suite.get("time", 0)), 2),
     "debug_guards": "passed" if sys.argv[3] == "0" else "failed",
+    "trace_validation": "passed" if sys.argv[4] == "0" else "failed",
     "tests": tests,
 }
 json.dump(doc, open(sys.argv[2], "w"), indent=1)
 print(
     f"chaos suite: {doc['passed']} passed, {doc['failed']} failed, "
     f"{doc['skipped']} skipped in {doc['duration_s']}s, "
-    f"debug_guards={doc['debug_guards']} -> {sys.argv[2]}"
+    f"debug_guards={doc['debug_guards']}, "
+    f"trace_validation={doc['trace_validation']} -> {sys.argv[2]}"
 )
 EOF
 rm -f "$report"

@@ -468,6 +468,82 @@ def test_unbounded_queue_bounded_forms_clean():
     assert _lint(src, rule="unbounded-queue") == []
 
 
+# ---------------------------------------------------------------------------
+# rule: span-discipline
+# ---------------------------------------------------------------------------
+
+
+def test_span_discipline_flags_non_context_manager_spans():
+    """A span created outside a with statement records nothing (never
+    entered) or dangles forever (entered, never exited) — both read as
+    instrumentation while measuring nothing."""
+    src = """
+    def leak(reg):
+        sp = reg.span("gc_ot", level=1)     # never entered
+        ctx = self.obs.span("ingest")       # manually entered, leakable
+        ctx.__enter__()
+        reg.span("fss")                     # bare expression statement
+    """
+    fs = _lint(src, rule="span-discipline")
+    assert len(fs) == 3
+    assert all(f.rule == "span-discipline" for f in fs)
+
+
+def test_span_discipline_with_forms_and_other_attrs_clean():
+    src = """
+    def ok(reg, cs):
+        with reg.span("level", level=0) as sp:
+            with cs.obs.span("fss", level=0):
+                pass
+        sp2 = reg.current_span()            # not span()
+        n = numpy.span(3)                   # attr named span, still a
+        # span-shaped call: deliberately flagged only as a with-item
+        return sp, sp2, n
+    """
+    fs = _lint(src, rule="span-discipline")
+    # numpy.span(3) IS flagged (attr name is the signal — suppressions
+    # cover false positives); the with-forms and current_span are clean
+    assert len(fs) == 1 and fs[0].line == 7
+
+
+def test_span_discipline_flags_telemetry_in_jit_bodies():
+    src = """
+    import jax
+
+    @jax.jit
+    def kernel(x, reg):
+        obs.emit("level.done", n=3)         # records once per COMPILE
+        reg.observe("level_latency", 0.1)   # ditto
+        return x + 1
+
+    def host(reg):
+        obs.emit("level.done", n=3)         # host-side: fine
+        reg.observe("level_latency", 0.1)
+    """
+    fs = _lint(src, rule="span-discipline")
+    assert len(fs) == 2
+    assert all("jit" in f.message for f in fs)
+
+
+def test_span_discipline_scope_and_suppression():
+    src = """
+    def leak(reg):
+        sp = reg.span("gc_ot")
+    """
+    # out of scope (span_modules): clean
+    assert _lint(
+        src, relpath="fuzzyheavyhitters_tpu/workloads/x.py",
+        rule="span-discipline",
+    ) == []
+    suppressed = """
+    def managed(reg):
+        # fhh-lint: disable=span-discipline (enter/exit managed across seal boundaries)
+        sp = reg.span("ingest")
+        sp.__enter__()
+    """
+    assert _lint(suppressed, rule="span-discipline") == []
+
+
 def test_unbounded_queue_scoped_and_suppressible():
     src = """
     import collections
@@ -703,7 +779,8 @@ def test_pyproject_and_dataclass_defaults_do_not_drift():
         "hot_modules", "hot_roots", "secret_lexicon", "sink_calls",
         "print_scope", "print_allowed", "shared_state_modules",
         "await_modules", "readback_modules", "queue_modules",
-        "race_modules", "guards", "default_paths", "baseline",
+        "span_modules", "race_modules", "guards", "default_paths",
+        "baseline",
     ):
         assert getattr(operative, key) == getattr(defaults, key), key
 
@@ -844,6 +921,7 @@ def test_every_rule_has_fixture_coverage():
         "chunked-device-readback",
         "unbounded-await",
         "unbounded-queue",
+        "span-discipline",
         # fixtures in tests/test_concurrency.py
         "guarded-state-unlocked",
         "stale-read-across-await",

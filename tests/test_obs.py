@@ -167,6 +167,44 @@ def test_report_is_json_serializable():
     assert rt["counters"]["n"]["total"] == 3
 
 
+def test_session_registry_churn_stays_bounded():
+    """Satellite regression (PR 13): a long-lived multi-tenant server
+    whose collections churn creates one ``server{N}:{key}`` registry per
+    session — every dropped one lands in the SAME bounded final-snapshot
+    retention as process registries (obs.metrics._MAX_FINAL, oldest
+    discarded + counted), so neither the snapshot list nor the no-arg
+    run report can grow without bound, and the report stays writable."""
+    cap = obsmetrics._MAX_FINAL
+    before_live = len(obsmetrics.all_registries())
+    for i in range(cap + 40):
+        r = obsmetrics.Registry(f"server0:churn{i}")
+        r.count("pool_admitted_keys", i, level=0)
+        r.observe("level_latency", 0.01)  # hists retained too
+        del r
+    gc.collect()
+    snaps = obsmetrics.final_snapshots()
+    assert len(snaps) <= cap
+    # the newest churned sessions survived, the oldest fell off COUNTED
+    names = [n for n, _s, _r in snaps]
+    assert f"server0:churn{cap + 39}" in names
+    assert obsmetrics.final_dropped() > 0
+    doc = obs.run_report()
+    # bounded report: at most cap retained snapshots + the live set
+    assert len(doc["registries"]) <= cap + before_live + 8
+    assert doc["dropped_registries"] == obsmetrics.final_dropped()
+    # a retained per-session snapshot still carries its accounting
+    # (counters AND the new latency histograms) into the report
+    key = next(
+        k for k in doc["registries"]
+        if k.startswith(f"server0:churn{cap + 39}")
+    )
+    snap = doc["registries"][key]
+    assert snap["counters"]["pool_admitted_keys"]["total"] == cap + 39
+    assert snap["hists"]["level_latency"]["count"] == 1
+    # and the sessions rollup keyed them without unbounded growth either
+    assert len(doc["sessions"]["per_session"]) <= cap + 8
+
+
 # ---------------------------------------------------------------------------
 # structured logs
 # ---------------------------------------------------------------------------

@@ -1,0 +1,749 @@
+"""fhh-trace + SLO-histogram suite: distributed tracing across the
+leader and both collector servers, the fixed-bucket latency histograms,
+the status/run-report ``slo`` surfaces, trace behavior under faults
+(reconnect replays record each span ONCE; a severed data plane marks
+the open span error=true), the chip-profiler gating, and the
+zero-cost-when-disabled contract (pinned like FHH_DEBUG_GUARDS).
+
+Shapes mirror tests/test_resilience.py (L=5, d=1) so the crawl kernels
+compile once across the suites.
+"""
+
+import asyncio
+import json
+import time
+
+import numpy as np
+import pytest
+
+from fuzzyheavyhitters_tpu import obs
+from fuzzyheavyhitters_tpu.obs import hist as histmod
+from fuzzyheavyhitters_tpu.obs import metrics as obsmetrics
+from fuzzyheavyhitters_tpu.obs import report as obsreport
+from fuzzyheavyhitters_tpu.obs import trace as tracemod
+from fuzzyheavyhitters_tpu.ops import ibdcf
+from fuzzyheavyhitters_tpu.protocol import driver, rpc
+from fuzzyheavyhitters_tpu.protocol.leader_rpc import RpcLeader, WindowedIngest
+from fuzzyheavyhitters_tpu.resilience.chaos import ChaosProxy, parse_faults
+from fuzzyheavyhitters_tpu.utils import bits as bitutils
+from fuzzyheavyhitters_tpu.utils.config import Config
+
+BASE_PORT = 42731
+
+
+@pytest.fixture(autouse=True)
+def _module_cpu(cpu_default):
+    yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(5)
+
+
+@pytest.fixture
+def trace_dir(tmp_path, monkeypatch):
+    """Arm fhh-trace into a per-test directory; disarm + re-resolve on
+    the way out so no other test sees a writer."""
+    d = tmp_path / "trace"
+    monkeypatch.setenv(tracemod.ENV_DIR, str(d))
+    tracemod._refresh()
+    yield d
+    monkeypatch.delenv(tracemod.ENV_DIR, raising=False)
+    tracemod._refresh()
+
+
+def _cfg(port_base, **kw):
+    defaults = dict(
+        data_len=5,
+        n_dims=1,
+        ball_size=1,
+        addkey_batch_size=8,
+        num_sites=4,
+        threshold=0.2,
+        zipf_exponent=1.03,
+        server0=f"127.0.0.1:{port_base}",
+        server1=f"127.0.0.1:{port_base + 10}",
+        distribution="zipf",
+        f_max=32,
+    )
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+def _client_keys(rng, L, n):
+    pts = np.concatenate(
+        [np.full(n - 4, 11), rng.integers(0, 1 << L, size=4)]
+    )[:, None]
+    pts_bits = np.array(
+        [[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts]
+    )
+    return ibdcf.gen_l_inf_ball(pts_bits, 1, rng, engine="np")
+
+
+async def _start_servers(cfg, port_base, ckpt_dir=None):
+    s0 = rpc.CollectorServer(0, cfg, ckpt_dir=ckpt_dir)
+    s1 = rpc.CollectorServer(1, cfg, ckpt_dir=ckpt_dir)
+    t1 = asyncio.create_task(
+        s1.start("127.0.0.1", port_base + 10, "127.0.0.1", port_base + 11)
+    )
+    await asyncio.sleep(0.05)
+    t0 = asyncio.create_task(
+        s0.start("127.0.0.1", port_base, "127.0.0.1", port_base + 11)
+    )
+    await asyncio.gather(t0, t1)
+    return s0, s1
+
+
+async def _bring_up(cfg, port, ckpt_dir=None, dial0=None):
+    live = {}
+    live["s0"], live["s1"] = await _start_servers(cfg, port, ckpt_dir)
+    d0 = ("127.0.0.1", port) if dial0 is None else dial0
+    c0 = await rpc.CollectorClient.connect(*d0)
+    c1 = await rpc.CollectorClient.connect("127.0.0.1", port + 10)
+    lead = RpcLeader(cfg, c0, c1)
+    await lead._both("reset")
+    return lead, c0, c1, live
+
+
+async def _teardown(clients, live, *proxies):
+    for px in proxies:
+        await px.stop()
+    for c in clients:
+        await c.aclose()
+    for s in live.values():
+        await s.aclose()
+
+
+def _chunk(k, sl):
+    return tuple(np.asarray(x)[sl] for x in k)
+
+
+def _hitters(res):
+    return {
+        tuple(int(v) for v in r): int(c)
+        for r, c in zip(res.decode_ints(), res.counts)
+    }
+
+
+def _events(trace_dir):
+    tracemod.flush()
+    return tracemod.load_events(str(trace_dir))
+
+
+# ---------------------------------------------------------------------------
+# histograms (obs/hist.py)
+# ---------------------------------------------------------------------------
+
+
+def test_hist_quantiles_and_exact_max():
+    h = histmod.Histogram()
+    for v in (0.001, 0.002, 0.004, 0.1, 0.1, 0.1, 5.0):
+        h.observe(v)
+    assert h.count == 7 and h.max == 5.0
+    # quantile estimates are good to ~one bucket width (58%)
+    assert 0.05 <= h.quantile(0.5) <= 0.16
+    assert h.quantile(0.99) <= 5.0
+    assert h.quantile(0.95) <= 5.0
+    s = h.summary()
+    assert s["count"] == 7 and s["max_s"] == 5.0
+    assert histmod.Histogram().quantile(0.5) is None  # empty = None
+
+
+def test_hist_merge_is_bucketwise_and_order_free():
+    a, b = histmod.Histogram(), histmod.Histogram()
+    for v in (0.01, 0.02, 0.03):
+        a.observe(v)
+    for v in (1.0, 2.0):
+        b.observe(v)
+    m1 = histmod.Histogram.merged([a, b])
+    m2 = histmod.Histogram.merged([b, a, None])  # None tolerated
+    assert m1.count == m2.count == 5
+    assert m1.counts == m2.counts
+    assert m1.quantile(0.95) == m2.quantile(0.95)
+
+
+def test_hist_snapshot_round_trip_and_negative_clamp():
+    h = histmod.Histogram()
+    h.observe(-1.0)  # clamped, not a crash
+    h.observe(float("nan"))
+    h.observe(0.25)
+    h2 = histmod.Histogram.from_snapshot(h.snapshot())
+    assert h2.count == h.count and h2.counts == h.counts
+    assert h2.quantile(0.99) == pytest.approx(h.quantile(0.99))
+
+
+def test_registry_observe_reset_and_report_shape():
+    reg = obsmetrics.Registry("t-hist")
+    assert reg.report() == {"counters": {}, "gauges": {}, "phases": {}}
+    reg.observe("level_latency", 0.05)
+    reg.observe("rpc:tree_crawl", 0.002)
+    rep = reg.report()
+    assert rep["hists"]["level_latency"]["count"] == 1
+    assert json.loads(json.dumps(rep))  # still json-serializable
+    summ = reg.hists_summary()
+    assert set(summ) == {"level_latency", "rpc:tree_crawl"}
+    assert summ["level_latency"]["p95_s"] is not None
+    reg.reset()
+    # the hists key disappears with the histograms (pre-SLO shape)
+    assert reg.report() == {"counters": {}, "gauges": {}, "phases": {}}
+
+
+def test_report_slo_section_merges_across_registries():
+    a = obsmetrics.Registry("t-slo-a")
+    b = obsmetrics.Registry("t-slo-b")
+    for v in (0.1, 0.2):
+        a.observe("level_latency", v)
+    b.observe("level_latency", 0.4)
+    a.observe("rpc:status", 0.001)
+    doc = obsreport.run_report([a, b])
+    slo = doc["slo"]
+    assert slo["level_latency"]["count"] == 3  # bucketwise merge
+    assert set(slo["level_latency"]["by_registry"]) == {"t-slo-a", "t-slo-b"}
+    assert slo["verbs"]["status"]["count"] == 1
+    # no histograms anywhere -> no section at all
+    empty = obsmetrics.Registry("t-slo-empty")
+    assert "slo" not in obsreport.run_report([empty])
+
+
+# ---------------------------------------------------------------------------
+# zero-cost when disabled (the FHH_DEBUG_GUARDS contract)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_disabled_is_structurally_zero_cost(tmp_path, monkeypatch):
+    monkeypatch.delenv(tracemod.ENV_DIR, raising=False)
+    tracemod._refresh()
+    assert tracemod.enabled() is False
+    reg = obsmetrics.Registry("t-off")
+    with tracemod.root("crawl") as tid:
+        assert tid is None  # no trace minted
+        with reg.span("level", level=0):
+            pass
+    # no writer, no context, no files — the span path touched nothing
+    assert tracemod._WRITER is None
+    assert tracemod.current_ids() is None
+    assert not list(tmp_path.iterdir())
+    # and the per-span overhead is ONE flag read: span_begin is never
+    # called (the _SpanCtx gate is trace.enabled())
+    assert tracemod.wire_ctx() is None
+
+
+def test_trace_bad_dir_degrades_without_killing_telemetry(monkeypatch):
+    monkeypatch.setenv(tracemod.ENV_DIR, "/proc/noexist/denied")
+    tracemod._refresh()
+    reg = obsmetrics.Registry("t-bad-dir")
+    with tracemod.root("crawl"):
+        with reg.span("level", level=0):
+            pass  # must not raise
+    assert reg.timer_seconds("level") >= 0  # metrics still recorded
+    monkeypatch.delenv(tracemod.ENV_DIR, raising=False)
+    tracemod._refresh()
+
+
+# ---------------------------------------------------------------------------
+# span recording: parent chains, error marking, ring rotation
+# ---------------------------------------------------------------------------
+
+
+def test_span_parent_chain_and_error_flag(trace_dir):
+    reg = obsmetrics.Registry("t-spans")
+    with tracemod.root("crawl") as tid:
+        assert tid is not None
+        with reg.span("level", level=3):
+            with reg.span("fss", level=3):
+                pass
+        with pytest.raises(ConnectionError):
+            with reg.span("gc_ot", level=3):
+                raise ConnectionError("data plane down")
+    evs = _events(trace_dir)
+    by_name = {e["name"]: e for e in evs if e["ph"] == "X"}
+    assert set(by_name) == {"level", "fss", "gc_ot"}
+    assert by_name["fss"]["parent"] == by_name["level"]["span"]
+    assert by_name["level"].get("parent") is None  # trace root
+    assert by_name["gc_ot"].get("error") is True
+    assert by_name["fss"].get("error") is None
+    assert all(e["trace"] == tid for e in by_name.values())
+    v = tracemod.validate(evs)
+    assert v["ok"], v["errors"]
+
+
+def test_nested_root_reuses_the_outer_trace(trace_dir):
+    with tracemod.root("window") as outer:
+        with tracemod.root("crawl") as inner:
+            assert inner == outer  # one trace per outermost root
+    with tracemod.root("crawl") as fresh:
+        assert fresh != outer
+
+
+def test_ring_rotation_bounds_the_segment(trace_dir, monkeypatch):
+    monkeypatch.setenv(tracemod.ENV_RING, "2048")  # min clamp applies
+    tracemod._refresh()
+    reg = obsmetrics.Registry("t-ring")
+    with tracemod.root("crawl"):
+        for i in range(2500):
+            with reg.span("fss", level=0):
+                pass
+    tracemod.flush()
+    names = sorted(p.name for p in trace_dir.iterdir())
+    assert any(n.endswith(".jsonl.1") for n in names)  # rotated once
+    evs = tracemod.load_events(str(trace_dir))
+    assert 0 < len(evs) <= 2 * 2048  # bounded at two segments
+
+
+def test_merge_applies_clock_offsets(trace_dir):
+    reg = obsmetrics.Registry("server0")
+    with tracemod.root("crawl"):
+        with reg.span("level", level=0):
+            pass
+    tracemod.note_clock("server0", offset_s=100.0, rtt_s=0.01)
+    lead = obsmetrics.Registry("leader")
+    with tracemod.root("crawl"):
+        with lead.span("level", level=0):
+            pass
+    evs = _events(trace_dir)
+    doc = tracemod.to_chrome(evs)
+    assert doc["otherData"]["clock_offsets"] == {"server0": 100.0}
+    comps = {
+        e["pid"]: e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    s0 = next(e for e in xs if comps[e["pid"]] == "server0")
+    ld = next(e for e in xs if comps[e["pid"]] == "leader")
+    # uncorrected both spans share ~one wall-clock; corrected, server0's
+    # sits ~100 s earlier on the merged (leader-time) timeline
+    assert ld["ts"] - s0["ts"] > 90e6
+    # a per-session registry corrects by its base component's offset
+    assert tracemod._offset_for("server0:tenant", {"server0": 7.0}) == 7.0
+
+
+# ---------------------------------------------------------------------------
+# e2e: a supervised secure crawl produces ONE valid merged trace
+# ---------------------------------------------------------------------------
+
+
+def test_e2e_supervised_secure_crawl_trace_and_slo(rng, tmp_path, trace_dir):
+    """THE acceptance scenario: leader + both socket servers under
+    FHH_TRACE_DIR produce a merged Perfetto trace that validates —
+    every span parented under ONE crawl trace id, leader and server
+    components present, otext/eval/b2a secure-kernel child spans per
+    level, clock-offset records measured — while ``status`` and the run
+    report carry the level-latency/per-verb SLO histograms."""
+    L, n = 5, 12
+    port = BASE_PORT
+    k0, k1 = _client_keys(rng, L, n)
+    cfg = _cfg(port, secure_exchange=True)
+
+    async def run():
+        lead, c0, c1, live = await _bring_up(cfg, port)
+        res = await lead.run_supervised(n, k0, k1)
+        st = await c0.call("status")
+        await _teardown((c0, c1), live)
+        return res, st
+
+    res, st = asyncio.run(run())
+    assert _hitters(res)  # the crawl found its hitters
+
+    evs = _events(trace_dir)
+    verdict = tracemod.validate(evs)
+    assert verdict["ok"], verdict["errors"]
+    crawl_traces = [t for t in verdict["traces"] if t.startswith("crawl-")]
+    assert len(crawl_traces) == 1  # ONE trace id for the whole crawl
+    tid = crawl_traces[0]
+    spans = [e for e in evs if e["ph"] == "X" and e.get("trace") == tid]
+    comps = {e["comp"] for e in spans}
+    assert {"leader", "server0", "server1"} <= comps
+    # secure-kernel child spans present per level on the server tracks
+    for name in ("otext", "b2a", "gc_ot", "fss", "field"):
+        levels = {
+            e.get("level")
+            for e in spans
+            if e["name"] == name and e["comp"].startswith("server")
+        }
+        assert levels >= set(range(L)), (name, levels)
+    # every server phase span has a parent that exists (transitively up
+    # to the leader's call span) — spot-check the chain shape
+    by_id = {e["span"]: e for e in spans}
+    otext = next(e for e in spans if e["name"] == "otext")
+    chain = []
+    cur = otext
+    while cur.get("parent") is not None:
+        cur = by_id[cur["parent"]]
+        chain.append(cur["name"])
+    assert any(c.startswith("verb:") for c in chain)  # server verb span
+    assert chain[-1] == "level"  # rooted at the leader's level span
+    assert by_id[otext["parent"]]["comp"] == otext["comp"]
+    # clock handshake happened for both servers
+    clocks = {e["comp"] for e in evs if e["ph"] == "C"}
+    assert {"server0", "server1"} <= clocks
+
+    # merged trace loads as Chrome JSON with per-component tracks
+    out = tmp_path / "trace.json"
+    verdict2 = tracemod.merge(str(trace_dir), str(out))
+    assert verdict2["ok"]
+    doc = json.loads(out.read_text())
+    names = {
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert {"leader", "server0", "server1"} <= names
+
+    # SLO surfaces: status + run report
+    slo = st["slo"]
+    assert slo["level_latency"]["count"] >= L
+    assert slo["level_latency"]["p95_s"] is not None
+    assert any(k.startswith("rpc:") for k in slo)
+    assert st["sessions"]["per_session"]["default"]["last_progress_s"] >= 0
+    assert "clock" in st
+    doc = obsreport.run_report()
+    assert doc["slo"]["level_latency"]["p95_s"] is not None
+    assert "tree_crawl" in doc["slo"]["verbs"]
+
+
+# ---------------------------------------------------------------------------
+# faults: replays record once; severed planes mark spans error=true
+# ---------------------------------------------------------------------------
+
+
+def test_trace_under_chaos_replay_records_each_span_once(
+    rng, tmp_path, trace_dir
+):
+    """The PR-3 e2e chaos scenario with tracing ON: the leader↔s0 link
+    is severed in the response direction (verb executed, response lost
+    — the reconnect replays the SAME req_id AND the same trace span id)
+    and s1 is killed/restarted at the first checkpoint.  The merged
+    trace must validate, each server-side verb execution must appear
+    EXACTLY once per (trace, parent, name) — the replay was answered
+    from the dedup cache, not re-recorded — and the severed data plane
+    leaves error=true spans, never dangling opens."""
+    L, n = 5, 12
+    port = BASE_PORT + 40
+    pxport = port + 20
+    k0, k1 = _client_keys(rng, L, n)
+    cfg = _cfg(port)
+    ck = tmp_path / "ckpt"
+    ck.mkdir()
+
+    async def run():
+        px = await ChaosProxy(
+            "127.0.0.1", pxport, "127.0.0.1", port,
+            parse_faults("ctl0:sever@msg=9,dir=s2c"), link="ctl0",
+        ).start()
+        live = {}
+        live["s0"], live["s1"] = await _start_servers(
+            cfg, port, ckpt_dir=str(ck)
+        )
+        c0 = await rpc.CollectorClient.connect("127.0.0.1", pxport)
+        c1 = await rpc.CollectorClient.connect("127.0.0.1", port + 10)
+        lead = RpcLeader(cfg, c0, c1)
+
+        async def assassin():
+            while lead.obs.counter_value("crawl_checkpoints") < 1:
+                await asyncio.sleep(0)
+            await live["s1"].aclose()
+            await asyncio.sleep(0.3)
+            live["s1"] = rpc.CollectorServer(1, cfg, ckpt_dir=str(ck))
+            await live["s1"].start(
+                "127.0.0.1", port + 10, "127.0.0.1", port + 11
+            )
+
+        kill = asyncio.create_task(assassin())
+        res = await lead.run_supervised(n, k0, k1, checkpoint_every=2)
+        await kill
+        st0 = await c0.call("status")
+        await _teardown((c0, c1), live, px)
+        return res, lead, st0
+
+    res, lead, st0 = asyncio.run(run())
+
+    # the faults happened and the crawl still matched the oracle
+    assert st0["dedup_hits"] >= 1
+    assert lead.obs.counter_value("recoveries") >= 1
+    want = driver.Leader(
+        *driver.make_servers(k0, k1), n_dims=1, data_len=L, f_max=cfg.f_max
+    ).run(nreqs=n, threshold=cfg.threshold)
+    assert _hitters(res) == _hitters(want)
+
+    evs = _events(trace_dir)
+    verdict = tracemod.validate(evs)
+    assert verdict["ok"], verdict["errors"]
+    # replay dedup: a server-side verb execution is keyed by its parent
+    # (the client call span, which replays VERBATIM) — if the severed
+    # verb had re-executed, its (trace, parent, name) would repeat
+    seen = {}
+    for e in evs:
+        if e["ph"] != "X" or not e["name"].startswith("verb:"):
+            continue
+        key = (e.get("trace"), e.get("parent"), e["name"], e["comp"])
+        seen[key] = seen.get(key, 0) + 1
+    assert seen, "no verb spans recorded"
+    dupes = {k: c for k, c in seen.items() if c > 1}
+    assert not dupes, f"replayed verbs re-recorded: {dupes}"
+    # the killed server's data plane failed mid-exchange somewhere: the
+    # unwound spans carry error=true instead of dangling open
+    errs = [e for e in evs if e["ph"] == "X" and e.get("error")]
+    assert errs, "no error-marked spans despite a sever + kill"
+
+
+# ---------------------------------------------------------------------------
+# windowed SLO: seal-to-hitters + ingest admit latency
+# ---------------------------------------------------------------------------
+
+
+def test_windowed_seal_to_hitters_histograms(rng, trace_dir):
+    L, n = 5, 12
+    port = BASE_PORT + 80
+    k0, k1 = _client_keys(rng, L, n)
+    cfg = _cfg(port)
+
+    async def run():
+        lead, c0, c1, live = await _bring_up(cfg, port)
+        wi = WindowedIngest(lead, checkpoint=False)
+        for i in range(n):
+            await wi.submit(
+                f"c{i % 4}", _chunk(k0, slice(i, i + 1)),
+                _chunk(k1, slice(i, i + 1)),
+            )
+        await wi.seal_window()
+        res = await wi.crawl_window(0)
+        st = await c0.call("status")
+        s0 = live["s0"]
+        driver_h = wi.obs.hist("seal_to_hitters")
+        admit_h = wi.obs.hist("ingest_admit")
+        server_h = s0.obs.hist("seal_to_hitters")
+        await _teardown((c0, c1), live)
+        return res, st, driver_h, admit_h, server_h
+
+    res, st, driver_h, admit_h, server_h = asyncio.run(run())
+    assert _hitters(res)
+    # driver-side: one sealed window crawled -> one observation; admits
+    # were counted per submission
+    assert driver_h is not None and driver_h.count == 1
+    assert driver_h.max > 0
+    assert admit_h is not None and admit_h.count == n
+    # server-side twin (final_shares observes from the pool's seal
+    # instant), and it reaches the status slo section
+    assert server_h is not None and server_h.count == 1
+    assert st["slo"]["seal_to_hitters"]["count"] == 1
+    # the report slo section rolls both views up
+    doc = obsreport.run_report()
+    assert doc["slo"]["seal_to_hitters"]["count"] >= 2
+    assert doc["slo"]["ingest_admit"]["p95_s"] is not None
+    # the window trace is distinct from nothing — one window trace id
+    evs = _events(trace_dir)
+    wins = {e.get("trace") for e in evs if str(e.get("trace", "")).startswith("window-")}
+    assert len(wins) == 1
+
+
+# ---------------------------------------------------------------------------
+# per-session heartbeat gap (satellite: last_progress_s)
+# ---------------------------------------------------------------------------
+
+
+def test_last_progress_gap_names_the_wedged_tenant(rng):
+    """A second collection uploads keys then goes idle; a later probe
+    from ANOTHER session's connection shows tenant t2's
+    ``last_progress_s`` growing while the probing session's stays ~0 —
+    the wedged-tenant signal the satellite asks for, visible from
+    ``status`` without reading logs."""
+    port = BASE_PORT + 120
+    k0, _k1 = _client_keys(rng, 5, 8)
+    cfg = _cfg(port)
+
+    async def run():
+        lead, c0, c1, live = await _bring_up(cfg, port)
+        ct = await rpc.CollectorClient.connect(
+            "127.0.0.1", port, collection="t2"
+        )
+        await ct.call("add_keys", {"keys": _chunk(k0, slice(0, 4))})
+        await asyncio.sleep(0.3)  # t2 idles (its last verb completed)
+        # a REAL verb progresses default; the status probes below must
+        # NOT (a probe resetting the gap would mask the wedge signal)
+        await c0.call("add_keys", {"keys": _chunk(k0, slice(4, 6))})
+        await c0.call("status")
+        st = await c0.call("status")  # probe on the DEFAULT session
+        rows = st["sessions"]["per_session"]
+        s0 = live["s0"]
+        ts = s0.obs.gauge_value("last_progress_ts")
+        await ct.aclose()
+        await _teardown((c0, c1), live)
+        return rows, ts
+
+    rows, ts = asyncio.run(run())
+    assert rows["t2"]["last_progress_s"] >= 0.25  # the gap grew
+    assert rows["default"]["last_progress_s"] < 0.25  # real verb just ran
+    assert ts is not None and abs(time.time() - ts) < 60
+    # the run report's per-session row carries the age too — only for
+    # NAMED collections (the default session rides the bare registries)
+    reg = obsmetrics.Registry("server0:tenantX")
+    reg.count("tenant_device_turns")
+    reg.gauge("last_progress_ts", time.time() - 3.0)
+    reg.timer_add("fss", 0.1, level=0)
+    doc = obsreport.run_report([reg])
+    row = doc["sessions"]["per_session"]["tenantX"]
+    assert 2.0 <= row["last_progress_s"] <= 60.0
+
+
+def test_status_probe_does_not_reset_the_gap_or_flood_verbs(rng):
+    """Review regression: polling status must neither reset
+    ``last_progress_s`` (it would mask the wedged-tenant signal it
+    exists to read) nor pile probe counts into the rpc:* verbs table."""
+    port = BASE_PORT + 160
+    k0, _k1 = _client_keys(rng, 5, 8)
+    cfg = _cfg(port)
+
+    async def run():
+        lead, c0, c1, live = await _bring_up(cfg, port)
+        await c0.call("add_keys", {"keys": _chunk(k0, slice(0, 2))})
+        await asyncio.sleep(0.25)
+        for _ in range(5):
+            await c0.call("status")
+        st = await c0.call("status")
+        await _teardown((c0, c1), live)
+        return st
+
+    st = asyncio.run(run())
+    # six probes later the gap still measures from the add_keys
+    assert st["sessions"]["per_session"]["default"]["last_progress_s"] >= 0.2
+    assert "rpc:status" not in st["slo"]
+    assert "rpc:add_keys" in st["slo"]
+
+
+def test_call_span_marks_server_error_responses(rng, trace_dir):
+    """Review regression: a verb the SERVER failed (an __error__
+    response, not a transport loss) must close the client call span
+    error=true — filtering the merged timeline by error has to surface
+    server-side failures too."""
+    port = BASE_PORT + 200
+    cfg = _cfg(port)
+
+    async def run():
+        lead, c0, c1, live = await _bring_up(cfg, port)
+        with tracemod.root("crawl"):
+            with pytest.raises(RuntimeError, match="tree_init before"):
+                await c0.call("tree_init", {})  # no keys: server refuses
+        await _teardown((c0, c1), live)
+
+    asyncio.run(run())
+    evs = _events(trace_dir)
+    call = next(e for e in evs if e.get("name") == "call:tree_init")
+    assert call.get("error") is True
+    verb = next(e for e in evs if e.get("name") == "verb:tree_init")
+    assert verb.get("error") is True  # the span unwound by the raise
+
+
+def test_clock_offsets_prefer_the_tightest_rtt():
+    """Review regression: a chaos-era clock sample measured across a
+    reconnect (huge rtt, bogus midpoint) must lose to a tight one."""
+    evs = [
+        {"ph": "C", "comp": "server0", "off": 40.0, "rtt": 80.0},
+        {"ph": "C", "comp": "server0", "off": 0.002, "rtt": 0.001},
+        {"ph": "C", "comp": "server0", "off": 39.0, "rtt": 78.0},
+    ]
+    assert tracemod.clock_offsets(evs) == {"server0": 0.002}
+    # no rtt anywhere: median fallback
+    evs = [
+        {"ph": "C", "comp": "s", "off": v} for v in (1.0, 5.0, 9.0)
+    ]
+    assert tracemod.clock_offsets(evs) == {"s": 5.0}
+
+
+def test_sealed_at_survives_ingest_checkpoint_round_trip(rng, tmp_path):
+    """Review regression: the seal instant rides the ingest checkpoint,
+    so a recovered window still observes its seal-to-hitters latency
+    (the replayed seal verb is a no-op on an already-sealed pool and
+    must not restamp the clock)."""
+    port = BASE_PORT + 240
+    k0, _k1 = _client_keys(rng, 5, 8)
+    cfg = _cfg(port)
+    s = rpc.CollectorServer(0, cfg, ckpt_dir=str(tmp_path))
+
+    async def go():
+        await s.submit_keys({
+            "window": 0, "sub_id": "a", "client_id": "c",
+            "keys": _chunk(k0, slice(0, 4)),
+        })
+        await s.window_seal({"window": 0})
+        sealed_at = s._default()._ingest_pools[0].sealed_at
+        await s.tree_checkpoint({"level": -1, "ingest_only": True})
+        fresh = rpc.CollectorServer(0, cfg, ckpt_dir=str(tmp_path))
+        await fresh.tree_restore({"level": -1})
+        pool = fresh._default()._ingest_pools[0]
+        return sealed_at, pool
+
+    sealed_at, pool = asyncio.run(go())
+    assert sealed_at is not None
+    assert pool.sealed and pool.sealed_at == sealed_at
+
+
+# ---------------------------------------------------------------------------
+# chip-profiler gating (FHH_PROFILE / FHH_PROFILE_LEVELS)
+# ---------------------------------------------------------------------------
+
+
+class _FakeProfiler:
+    def __init__(self):
+        self.calls = []
+
+    def start_trace(self, d):
+        self.calls.append(("start", d))
+
+    def stop_trace(self):
+        self.calls.append(("stop", None))
+
+
+def test_profile_capture_gating(tmp_path, monkeypatch):
+    import jax
+
+    fake = _FakeProfiler()
+    monkeypatch.setattr(jax.profiler, "start_trace", fake.start_trace)
+    monkeypatch.setattr(jax.profiler, "stop_trace", fake.stop_trace)
+
+    # unset: a no-op
+    monkeypatch.delenv(tracemod.ENV_PROFILE, raising=False)
+    with tracemod.profile_capture("crawl") as live:
+        assert live is False
+    assert fake.calls == []
+
+    prof_dir = tmp_path / "prof"
+    monkeypatch.setenv(tracemod.ENV_PROFILE, str(prof_dir))
+    # whole-crawl mode: crawl captures, per-level hooks stand down
+    with tracemod.profile_capture("level", level=3) as live:
+        assert live is False
+    with tracemod.profile_capture("crawl") as live:
+        assert live is True
+    assert fake.calls == [("start", str(prof_dir)), ("stop", None)]
+
+    # level mode: only the named levels capture; crawl stands down
+    fake.calls.clear()
+    monkeypatch.setenv(tracemod.ENV_PROFILE_LEVELS, "2,5")
+    with tracemod.profile_capture("crawl") as live:
+        assert live is False
+    with tracemod.profile_capture("level", level=3) as live:
+        assert live is False
+    with tracemod.profile_capture("level", level=5) as live:
+        assert live is True
+    assert fake.calls == [("start", str(prof_dir)), ("stop", None)]
+
+    # captures recorded with kind/level and surfaced by the report
+    caps = tracemod.profile_captures()
+    assert len(caps) >= 2
+    assert caps[-1]["kind"] == "level" and caps[-1]["level"] == 5
+    doc = obsreport.run_report([obsmetrics.Registry("t-prof")])
+    assert doc["slo"]["profile"][-1]["level"] == 5
+
+
+def test_profile_capture_survives_profiler_failure(tmp_path, monkeypatch):
+    import jax
+
+    def boom(_d):
+        raise RuntimeError("profiler busy")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    monkeypatch.setenv(tracemod.ENV_PROFILE, str(tmp_path / "p"))
+    monkeypatch.delenv(tracemod.ENV_PROFILE_LEVELS, raising=False)
+    with tracemod.profile_capture("crawl") as live:
+        assert live is False  # degraded, never raised
