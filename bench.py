@@ -611,7 +611,7 @@ async def _bring_up_pair(cfg, port):
     return lead, c0, c1, s0, s1
 
 
-def bench_secure(n=1024, L=12, port=39831, shard_nodes=4, pipeline_depth=4):
+def bench_secure(n=1024, L=12, port=21831, shard_nodes=4, pipeline_depth=4):
     """Secure-mode aggregate crawl: both collector servers in one process
     with the REAL 2PC data plane (secure_exchange=true), full level loop
     over localhost sockets on the default device.  End-to-end wall time.
@@ -817,7 +817,7 @@ def bench_secure(n=1024, L=12, port=39831, shard_nodes=4, pipeline_depth=4):
     }
 
 
-def bench_multichip(n=1024, L=12, port=40231, shards=(1, 2, 4, 8),
+def bench_multichip(n=1024, L=12, port=22231, shards=(1, 2, 4, 8),
                     f_max=64, kernel_shards=(1, 2, 4, 8)):
     """Multi-chip collector servers: secure clients/sec as each server's
     client axis shards over 1/2/4/8 LOCAL data devices
@@ -974,6 +974,191 @@ def bench_multichip(n=1024, L=12, port=40231, shards=(1, 2, 4, 8),
         "data_len": L,
         "n_devices": n_devices,
         "mesh_status": k_top_status or top[2],
+    }
+
+
+def bench_sketch(n=1024, L=12, port=23031, shards=(1, 2, 4, 8),
+                 data_devices=8, secure=True):
+    """Malicious-secure sketch verification in the fast lane
+    (parallel/sketch_shard.py): the headline is
+    ``malicious_overhead_vs_semi_honest`` — one crawl WITH the sketch
+    gates (MAC'd payload DPFs verified per level, the device-resident
+    fused verify) over the identical crawl WITHOUT them, same config,
+    same warmed servers per leg.  A sharded sweep varies
+    ``Config.sketch_shards`` over ``shards`` on an
+    ``data_devices``-wide data mesh; every sharded leg is gated TWICE
+    before anything is reported:
+
+    - DIRECTLY: the trusted challenge stream (r + rand rows, by CTR
+      seek) and the cor-share wire bytes at shard count k are asserted
+      byte-identical to the single fused program's, per field — the
+      check that catches a seek bug e2e results cannot (honest clients
+      pass under ANY challenge, so result equality alone is blind to a
+      perturbed stream);
+    - E2E: the sharded leg's heavy hitters, paths, AND the per-client
+      liveness vector are asserted bit-identical to the unsharded
+      malicious leg's.
+
+    Clients are honest here (the overhead number should price the
+    checks, not a cheater's exclusion); cheater-detection parity is
+    tier-1's job (tests/test_sketch_shard.py)."""
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+
+    from fuzzyheavyhitters_tpu.ops import ibdcf
+    from fuzzyheavyhitters_tpu.ops.fields import F255, FE62
+    from fuzzyheavyhitters_tpu.parallel import server_mesh, sketch_shard
+    from fuzzyheavyhitters_tpu.protocol import mpc, sketch as sketchmod
+    from fuzzyheavyhitters_tpu.utils.config import Config
+
+    rng = np.random.default_rng(9)
+    sites = rng.integers(0, 1 << L, size=8)
+    pts = sites[rng.integers(0, 8, size=n)]
+    pts_bits = (
+        ((pts[:, None, None] >> np.arange(L - 1, -1, -1)) & 1) > 0
+    )  # [n, 1, L] MSB-first
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng, engine=_keygen_engine())
+    seeds = rng.integers(0, 2**32, size=(n, 1, 2, 4), dtype=np.uint32)
+    cseed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    sk0, sk1 = sketchmod.gen(seeds, pts_bits, FE62, F255, cseed)
+
+    def leg_cfg(p, sketch_k):
+        return Config(
+            data_len=L, n_dims=1, ball_size=2, addkey_batch_size=1024,
+            num_sites=8, threshold=0.05, zipf_exponent=1.03,
+            server0=f"127.0.0.1:{p}", server1=f"127.0.0.1:{p + 10}",
+            distribution="zipf", f_max=64, secure_exchange=secure,
+            malicious=True, server_data_devices=data_devices,
+            sketch_shards=sketch_k,
+        )
+
+    n_devices = len(jax.devices())
+
+    def direct_gate(k) -> None:
+        """Challenge stream + cor wire at shard count k vs the single
+        fused program — byte-identical or the leg reports nothing."""
+        devs = tuple(jax.local_devices()[:k])
+        ss = sketch_shard.bind(devs, n, 1, k)
+        assert ss is not None and ss.k == k, (k, ss)
+        m, lvl = 8, 3
+        for field in (FE62, F255):
+            r_ref, rands_ref = sketchmod.shared_r_stream(
+                field, cseed, lvl, m, n
+            )
+            r, ra = sketch_shard.stream_parts(ss, field, cseed, lvl, m, n, 1)
+            assert np.array_equal(np.asarray(r_ref), r)
+            assert np.array_equal(np.asarray(rands_ref), ra)
+            w = 8 if field.limb_shape else 4
+            pairs = field.sample(jnp.asarray(rng.integers(
+                0, 2**32, size=(m, n, 1, 2, w), dtype=np.uint32
+            )))
+            trip, _ = mpc.gen_triples(field, (n, 1, mpc.CHECKS), cseed)
+            mk = field.sample(jnp.asarray(rng.integers(
+                0, 2**32, size=(n, w), dtype=np.uint32
+            )))
+            mk2 = field.mul(mk, mk)
+            cor_1, _ = sketch_shard.cor_state(
+                None, field, pairs, trip, mk, mk2, cseed, lvl
+            )
+            cor_k, _ = sketch_shard.cor_state(
+                ss, field, pairs, trip, mk, mk2, cseed, lvl
+            )
+            assert np.array_equal(
+                sketch_shard.wire(cor_1), sketch_shard.wire(cor_k)
+            ), (field.__name__, k)
+
+    async def one_leg(p, sketch_k, with_sketch=True):
+        cfg = leg_cfg(p, sketch_k)
+        lead, c0, c1, s0, s1 = await _bring_up_pair(cfg, p)
+        try:
+            sks = (sk0, sk1) if with_sketch else (None, None)
+            await lead.upload_keys(k0, k1, *sks)
+            await lead.warmup()  # fused verify ladder, off the clock
+            res = await lead.run(n)  # warm residual trace/dispatch cost
+            await lead._both("reset")
+            await lead.upload_keys(k0, k1, *sks)
+            t = time.perf_counter()
+            res = await lead.run(n)
+            dt = time.perf_counter() - t
+            alive = None if not with_sketch else s0.alive_keys.copy()
+            sketch_s = (
+                s0.obs.timer_seconds("sketch")
+                + s1.obs.timer_seconds("sketch")
+            )
+            st = await c0.call("status")
+            return res, dt, alive, sketch_s, (st.get("mesh") or {})
+        finally:
+            for c in (c0, c1):
+                await c.aclose()
+            for s in (s0, s1):
+                await s.aclose()
+
+    # semi-honest reference: the identical crawl without the sketch
+    # gates (same shapes, same warmed servers-per-leg discipline)
+    res_semi, dt_semi, _, _, _ = asyncio.run(one_leg(port, 1, False))
+    rates: dict = {}
+    skipped: dict = {}
+    base_res = None
+    base_alive = None
+    top = (0, None, None)  # (shards, dt, verify seconds)
+    for i, k in enumerate(shards):
+        if k > 1 and (
+            k > n_devices
+            or server_mesh._largest_divisor_leq(n, k) != k
+        ):
+            skipped[str(k)] = "devices" if k > n_devices else "batch"
+            continue
+        if k > 1:
+            direct_gate(k)
+        res, dt, alive, sketch_s, mesh_st = asyncio.run(
+            one_leg(port + 100 + 40 * i, k)
+        )
+        if k > 1 and (mesh_st.get("sketch_shards") or 1) != k:
+            # the server's mesh could not hold k shards (fewer visible
+            # devices than requested): report it skipped, never as a
+            # sharded number it didn't earn
+            skipped[str(k)] = "devices"
+            continue
+        rates[str(k)] = round(n / dt, 1)
+        if base_res is None:
+            base_res, base_alive = res, alive
+        else:
+            # e2e gate: hitters, paths, AND liveness bit-identical to
+            # the unsharded malicious leg
+            assert np.array_equal(base_res.counts, res.counts)
+            assert np.array_equal(base_res.paths, res.paths)
+            assert np.array_equal(base_alive, alive)
+        if k >= top[0]:
+            top = (k, dt, sketch_s)
+    dt_mal = top[1]
+    if base_res is not None:
+        # honest clients: the malicious legs' outputs must equal the
+        # semi-honest reference's (the checks gate liveness, they never
+        # perturb counts), and every client must survive its checks
+        assert np.array_equal(base_res.counts, res_semi.counts)
+        assert np.array_equal(base_res.paths, res_semi.paths)
+        assert base_alive is not None and bool(base_alive.all())
+    return {
+        "bit_identical": base_res is not None and len(rates) >= 1,
+        "malicious_overhead_vs_semi_honest": (
+            None if dt_mal is None else round(dt_mal / dt_semi, 3)
+        ),
+        "sketch_clients_per_sec": (
+            None if dt_mal is None else round(n / dt_mal, 1)
+        ),
+        "semi_honest_clients_per_sec": round(n / dt_semi, 1),
+        "sketch_shards": top[0],
+        "clients_per_sec_by_shards": rates,
+        "verify_seconds": (
+            None if top[2] is None else round(top[2], 3)
+        ),
+        "skipped_shards": skipped,
+        "secure_exchange": bool(secure),
+        "n_clients": n,
+        "data_len": L,
+        "n_devices": n_devices,
     }
 
 
@@ -1339,7 +1524,7 @@ def bench_hash_margin(B=131072, S=2):
     return out
 
 
-def bench_upload(n=1_000_000, L=16, batch=4000, port=39731):
+def bench_upload(n=1_000_000, L=16, batch=4000, port=21731):
     """1M-key ingest benchmark: leader -> two servers over localhost TCP
     with the ROLLING upload window (leader_rpc.upload_keys; ref:
     leader.rs:340-364's 1000 in-flight batches).  Host-side only —
@@ -1389,7 +1574,7 @@ def bench_upload(n=1_000_000, L=16, batch=4000, port=39731):
     }
 
 
-def bench_ingest(n=65536, L=12, chunk=256, port=39931, threshold=0.05):
+def bench_ingest(n=65536, L=12, chunk=256, port=21931, threshold=0.05):
     """Streaming front-door benchmark (ROADMAP "Streaming ingestion",
     ≥ 100k keys/sec acceptance): clients submit key chunks continuously
     through the admission-controlled ``submit_keys`` verb into tumbling
@@ -1526,7 +1711,7 @@ def bench_ingest(n=65536, L=12, chunk=256, port=39931, threshold=0.05):
     return out
 
 
-def bench_multitenant(n=1024, L=10, port=40531, tenant_counts=(1, 2, 4),
+def bench_multitenant(n=1024, L=10, port=22531, tenant_counts=(1, 2, 4),
                       threshold=0.05):
     """Multi-tenant collection sessions (protocol/sessions.py): N
     concurrent collections on ONE server pair, each its own session
@@ -1902,6 +2087,11 @@ _COMPACT_KEYS = {
         "solo_clients_per_sec", "stall_fill_ratio",
         "bit_identical_vs_solo",
     ),
+    "sketch": (
+        "malicious_overhead_vs_semi_honest", "sketch_clients_per_sec",
+        "semi_honest_clients_per_sec", "bit_identical", "sketch_shards",
+        "verify_seconds",
+    ),
 }
 
 
@@ -2002,6 +2192,21 @@ def main():
             " shards=(1, 2, 4), f_max=32, kernel_shards=(1, 2))))"
         ),
     )
+    sketch = section(
+        "sketch",
+        "import json, bench;print(json.dumps(bench.bench_sketch()))",
+        # semi-honest reference + the sketch_shards sweep, each leg its
+        # own warmed server pair (fused verify ladder via warmup)
+        timeout_s=900,
+        # smoke: trusted exchange keeps the compile load inside the
+        # budget; the sketch lane (fused verify, sharded legs, both
+        # gates) is identical either way
+        smoke_code=(
+            "import json, bench;"
+            "print(json.dumps(bench.bench_sketch(n=64, L=6,"
+            " shards=(1, 2), secure=False)))"
+        ),
+    )
     secure_device = section(
         "secure_device",
         "import json, bench;print(json.dumps(bench.bench_secure_device()))",
@@ -2085,6 +2290,7 @@ def main():
         "crawl_hbm_max": crawl_hbm_max,
         "secure_crawl": secure,
         "multichip": multichip,
+        "sketch": sketch,
         "secure_device": secure_device,
         "hbm": hbm,
         "covid": covid,

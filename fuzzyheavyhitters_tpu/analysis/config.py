@@ -50,6 +50,7 @@ _DEFAULT_GUARDS = {
     "CollectionSession._sketch_parts": "_verb_lock",
     "CollectionSession._sketch_root": "_verb_lock",
     "CollectionSession._ratchet_digest": "_verb_lock",
+    "CollectionSession._window_sketch_root": "_verb_lock",
     # CollectorServer infra: the replay-dedup session table
     "CollectorServer._sessions": "_verb_lock",
     # WindowedIngest: gate-order == mirror-order state serializes on
@@ -133,9 +134,15 @@ class LintConfig:
     # there fetches once per SHARD) and protocol/rpc.py (the crawl
     # verbs' expand/open stages) joined the scope with the multi-chip
     # refactor.
+    # protocol/sketch.py + protocol/mpc.py joined with the fused
+    # malicious verify: the old chunked sketch_batch_size loop form
+    # (per-chunk cor/out fetches) is exactly what this rule exists to
+    # keep from growing back.
     readback_modules: tuple = (
         "fuzzyheavyhitters_tpu/protocol/secure.py",
         "fuzzyheavyhitters_tpu/protocol/rpc.py",
+        "fuzzyheavyhitters_tpu/protocol/sketch.py",
+        "fuzzyheavyhitters_tpu/protocol/mpc.py",
         "fuzzyheavyhitters_tpu/ops",
         "fuzzyheavyhitters_tpu/parallel",
     )

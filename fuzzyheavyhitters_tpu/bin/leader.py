@@ -162,9 +162,16 @@ async def _run(cfg, nreqs: int, rng) -> None:
     # snapshot is crawled while the next window keeps ingesting.  The
     # production shape on the ROADMAP, driven here from one process.
     windows = max(1, int(os.environ.get("FHH_WINDOWS", "1")))
-    if windows > 1 and sk0 is None:
+    if windows > 1:
+        # malicious mode streams too: the clients' sketch material rides
+        # each submission and every sealed window commits its own
+        # challenge root (protocol/rpc.py window_seal)
+        import jax as _jax
+
         from ..protocol.leader_rpc import WindowedIngest
 
+        sk0_leaves = None if sk0 is None else _jax.tree.leaves(sk0)
+        sk1_leaves = None if sk1 is None else _jax.tree.leaves(sk1)
         t0 = time.perf_counter()
         await asyncio.gather(c0.call("reset"), c1.call("reset"))
         wi = WindowedIngest(lead)
@@ -179,6 +186,14 @@ async def _run(cfg, nreqs: int, rng) -> None:
                     f"site{chunk_no % max(1, cfg.num_sites)}",
                     tuple(np.asarray(x)[sl] for x in k0),
                     tuple(np.asarray(x)[sl] for x in k1),
+                    sk0_chunk=(
+                        None if sk0_leaves is None
+                        else [np.asarray(x)[sl] for x in sk0_leaves]
+                    ),
+                    sk1_chunk=(
+                        None if sk1_leaves is None
+                        else [np.asarray(x)[sl] for x in sk1_leaves]
+                    ),
                 )
             stats = await wi.seal_window()
             if crawl_task is not None:

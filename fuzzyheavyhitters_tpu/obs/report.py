@@ -131,6 +131,9 @@ def run_report(registries=None) -> dict:
     sk = _secure_kernel_summary(out)
     if sk is not None:
         doc["secure_kernels"] = sk
+    sketch = _sketch_summary(out)
+    if sketch is not None:
+        doc["sketch"] = sketch
     ing = _ingest_summary(out)
     if ing is not None:
         doc["ingest"] = ing
@@ -349,6 +352,42 @@ def _secure_kernel_summary(registries: dict) -> dict | None:
             lvl: {n: round(v[n], 6) for n in names}
             for lvl, v in sorted(by_level.items(), key=lambda kv: int(kv[0]))
         },
+    }
+
+
+def _sketch_summary(registries: dict) -> dict | None:
+    """Cross-registry malicious-sketch rollup (the device-resident
+    sharded verify, parallel/sketch_shard.py): total verify seconds
+    (the per-level ``sketch`` phase summed across both servers), the
+    levels verified, and the verify's shard layout (``sketch_shards``
+    gauge — max across levels; 1 = the single fused program).  Present
+    only when a sketch verification ran — semi-honest runs never emit
+    these metrics."""
+    seconds = 0.0
+    levels: set = set()
+    shards = None
+    seen = False
+    for snap in registries.values():
+        t = snap.get("phases", {}).get("sketch")
+        if t is not None:
+            seen = True
+            seconds += t.get("seconds", 0.0)
+            levels |= set(t.get("by_level", {}))
+        g = snap.get("gauges", {}).get("sketch_shards")
+        if g is not None:
+            seen = True
+            vals = [v for v in g.get("by_level", {}).values()]
+            if g.get("last") is not None:
+                vals.append(g["last"])
+            if vals:
+                m = max(vals)
+                shards = m if shards is None else max(shards, m)
+    if not seen:
+        return None
+    return {
+        "verify_seconds": round(seconds, 6),
+        "levels_verified": len(levels),
+        "sketch_shards": shards,
     }
 
 

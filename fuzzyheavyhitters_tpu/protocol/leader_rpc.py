@@ -975,12 +975,19 @@ class WindowedIngest:
             self._span_ctx = None
 
     async def submit(self, client_id, k0_chunk, k1_chunk, *,
+                     sk0_chunk=None, sk1_chunk=None,
                      sub_id: str | None = None) -> dict:
         """Submit one client's key-share chunks (k0 to server 0, k1 to
         server 1) into the CURRENT window.  Blocks through Overloaded
         verdicts under the backoff policy; returns the gate verdict
         (``admitted`` or ``shed``).  Raises
-        :class:`IngestOverloadedError` when every attempt was rejected."""
+        :class:`IngestOverloadedError` when every attempt was rejected.
+
+        Malicious mode: ``sk0_chunk``/``sk1_chunk`` carry the client's
+        sketch key leaves (the ``upload_keys`` sk_chunk form — flat
+        ``jax.tree.leaves`` of its :class:`~.sketch.SketchKeyBatch`
+        share); they ride the same admission verdict, the same journal
+        record, and the same recovery replay as the ibDCF chunks."""
         self._ensure_span()
         t_admit = time.perf_counter()  # ingest-admit SLO clock (e2e:
         # gate + mirror + every Overloaded backoff this submission ate)
@@ -994,6 +1001,14 @@ class WindowedIngest:
             sub_id = f"{self.lead.c0.session_id}:{self._n_subs}"
         k0_chunk = tuple(np.asarray(a) for a in k0_chunk)
         k1_chunk = tuple(np.asarray(a) for a in k1_chunk)
+        if (sk0_chunk is None) != (sk1_chunk is None):
+            raise ValueError(
+                "sketch chunks come in pairs: pass sk0_chunk AND "
+                "sk1_chunk (one per server's share) or neither"
+            )
+        if sk0_chunk is not None:
+            sk0_chunk = [np.asarray(a) for a in sk0_chunk]
+            sk1_chunk = [np.asarray(a) for a in sk1_chunk]
         n_keys = int(k0_chunk[0].shape[0])
         attempt = 0
         faults = 0
@@ -1016,7 +1031,8 @@ class WindowedIngest:
                 while True:
                     try:
                         r0 = await self.lead.c0.call(
-                            "submit_keys", dict(base, keys=k0_chunk)
+                            "submit_keys",
+                            dict(base, keys=k0_chunk, sketch=sk0_chunk),
                         )
                         if r0.get("overloaded"):
                             break
@@ -1028,6 +1044,8 @@ class WindowedIngest:
                             "shed": bool(r0.get("shed")),
                             "k0": k0_chunk,
                             "k1": k1_chunk,
+                            "sk0": sk0_chunk,
+                            "sk1": sk1_chunk,
                         }
                         # journal BEFORE the mirror call: if s1 restarts
                         # mid-mirror, the recovery replay carries this
@@ -1040,7 +1058,7 @@ class WindowedIngest:
                         await self.lead.c1.call(
                             "submit_keys",
                             dict(
-                                base, keys=k1_chunk,
+                                base, keys=k1_chunk, sketch=sk1_chunk,
                                 mirror={"slot": rec["slot"],
                                         "shed": rec["shed"]},
                             ),
@@ -1116,7 +1134,15 @@ class WindowedIngest:
                         raise
                     await self._recover_ingest()
                     continue
-                if (r0["keys"], r0["subs"]) != (r1["keys"], r1["subs"]):
+                # sk_root rides the comparison: the two servers derive
+                # the window's challenge root from their own session
+                # coin flip — a plane re-key landing between the two
+                # seal-time handshakes would commit DIFFERENT roots,
+                # and a root mismatch silently excludes every honest
+                # client in the window (divergent challenge streams)
+                if (
+                    r0["keys"], r0["subs"], r0.get("sk_root")
+                ) != (r1["keys"], r1["subs"], r1.get("sk_root")):
                     raise RuntimeError(
                         f"window {w} pools diverged at seal: "
                         f"gate {r0} vs mirror {r1}"
@@ -1184,7 +1210,11 @@ class WindowedIngest:
         recoveries = 0
         while True:
             try:
-                await self.lead._both("window_load", {"window": w})
+                l0, _ = await self.lead._both("window_load", {"window": w})
+                # a malicious window's crawl must run the sketch_verify
+                # gates (the batch flow learns this at upload_keys; the
+                # windowed flow learns it from the loaded pool)
+                self.lead.has_sketch = bool(l0.get("sketch"))
                 with self.obs.span("window_crawl", level=w):
                     res = await self.lead.run(nreqs)
                 break
@@ -1265,7 +1295,16 @@ class WindowedIngest:
                     )
             await self._replay_journal(client, i)
             for w in sorted(self._sealed):
-                await client.call("window_seal", {"window": w})
+                req = {"window": w}
+                root = self._sealed[w].get("sk_root")
+                if root is not None:
+                    # the ORIGINAL window challenge root banked at first
+                    # seal: a journal-rebuilt pool on a restarted server
+                    # must commit THIS root, never derive a fresh one
+                    # (same Beaver slabs under a new root leak
+                    # <r - r', x> on the window's re-run)
+                    req["sk_root"] = root
+                await client.call("window_seal", req)
             obsmod.emit("ingest.server_reseeded", server=i)
 
     async def _replay_journal(self, client, which: int) -> None:
@@ -1283,6 +1322,7 @@ class WindowedIngest:
                         "sub_id": rec["sub_id"],
                         "client_id": rec["client_id"],
                         "keys": rec["k0"] if which == 0 else rec["k1"],
+                        "sketch": rec.get("sk0" if which == 0 else "sk1"),
                         "mirror": {"slot": rec["slot"], "shed": rec["shed"]},
                     },
                 )

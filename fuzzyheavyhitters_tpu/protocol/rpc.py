@@ -104,7 +104,7 @@ from ..obs import trace as obstrace
 from ..ops import baseot, dpf, gc, otext
 from ..ops.fields import F255, FE62
 from ..ops.ibdcf import EvalState, IbDcfKeyBatch
-from ..parallel import kernel_shard, server_mesh as smesh
+from ..parallel import kernel_shard, server_mesh as smesh, sketch_shard
 from ..resilience import chaos as reschaos
 from ..resilience import policy as respolicy
 from ..utils import guards
@@ -451,9 +451,38 @@ class CollectorServer:
             # committed their keys before this point), transcript = empty.
             # Both are checkpointed with the frontier, so later plane
             # resets / restarts cannot perturb any level's challenge.
-            cs._sketch_root = np.asarray(cs._sketch_seed, np.uint32).copy()
+            # A loaded STREAMING window overrides the root with its own
+            # seal-time commitment (sketch.window_root, installed by
+            # window_load): the root then survives server restarts via
+            # the seal stats + ingest checkpoint, so a recovered
+            # window's re-run replays the identical challenge instead
+            # of re-opening its slabs under a fresh coin flip.
+            root_src = (
+                cs._window_sketch_root
+                if cs._window_sketch_root is not None
+                else cs._sketch_seed
+            )
+            cs._sketch_root = np.asarray(root_src, np.uint32).copy()
             cs._ratchet_digest = sketchmod.transcript_init()
         return True
+
+    def _sketch_bind(self, cs, n: int, d: int):
+        """Resolve the sketch verify's shard binding for this session's
+        batch: the data mesh's leading devices under the
+        ``Config.sketch_shards`` budget (0 = auto follows the data
+        shards), ``None`` on a meshless server or when only one shard
+        fits — the single fused program then runs on the default device.
+        Pure lru-cached machinery underneath (like ``kernel_bind``)."""
+        if cs._mesh is None:
+            return None
+        budget = (
+            cs._mesh.shards
+            if self.cfg.sketch_shards <= 0
+            else min(int(self.cfg.sketch_shards), cs._mesh.shards)
+        )
+        return sketch_shard.bind(
+            cs._mesh._active_devices(), n, d, budget
+        )
 
     async def sketch_verify(self, req, cs: CollectionSession | None = None) -> np.ndarray:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the session's verb lock; sanitizer-validated)
         """Malicious-security check (ref intent: the TreeSketchFrontier*
@@ -510,7 +539,7 @@ class CollectorServer:
                 )
                 sides.append(p)
             pairs_fn = jnp.stack(sides)  # [2, N, d, LANES(, limbs)]
-            m_nodes, dpf_level = 2, 0
+            dpf_level = 0
         else:
             if L == 1:
                 # the level-0 full check already consumed triples_last; a
@@ -533,57 +562,52 @@ class CollectorServer:
             pairs_fn, _ = cs._sketch_pairs  # [F, N, d, LANES(, limbs)]
             fld = cs._sketch_pairs_field
             last = fld is F255
-            m_nodes, dpf_level = pairs_fn.shape[0], level - 1
+            dpf_level = level - 1
         challenge = cs.challenge_seed(level)
-        bs = max(
-            1,
-            self.cfg.sketch_batch_size_last if last else self.cfg.sketch_batch_size,
+        # device-resident, row-sharded verify (parallel/sketch_shard.py):
+        # the WHOLE level's check batch runs as one fused program per
+        # stage — sharded along the client axis across the data mesh
+        # when one is bound — with the challenge stream derived PER
+        # SHARD by CTR seek (bit-identical to the single-device draw),
+        # per-shard readbacks reassembled positionally into a
+        # byte-identical wire, and a single post-level verdict readback.
+        # The old sketch_batch_size host loop (one dispatch + TWO wire
+        # round trips per chunk) survives only in the spec helper
+        # (sketch.verify_level).
+        sk = cs._sketch
+        if last:
+            trip, mk, mk2 = sk.triples_last, sk.mac_key_last, sk.mac_key2_last
+        else:
+            # host slab slice: sketch key leaves are host numpy (the
+            # uploaded chunks), so the per-level slab costs no dispatch
+            trip = mpc.level_slab(sk.triples, dpf_level)
+            mk, mk2 = sk.mac_key, sk.mac_key2
+        ss = self._sketch_bind(cs, n, d)
+        cs.obs.gauge(
+            "sketch_shards", 1 if ss is None else ss.k, level=level
         )
-        ok_parts = []  # per-batch device verdicts; ONE fetch after the loop
-        for lo in range(0, n, bs):
-            sl = slice(lo, min(lo + bs, n))
-            ks = jax.tree.map(lambda a: a[sl], cs._sketch)
-            n_sl = min(lo + bs, n) - lo
-            r, rands = sketchmod.shared_r_stream(
-                fld, challenge, level, m_nodes, n_sl * d
+        with cs.obs.span("sketch", level=level):
+            cor, state = sketch_shard.cor_state(
+                ss, fld, pairs_fn, trip, mk, mk2, challenge, level
             )
-            rands = rands.reshape((n_sl, d, 3) + fld.limb_shape)
-            pairs = pairs_fn[:, sl]  # [F, n_sl, d, lanes(, limbs)]
-            pairs = jnp.moveaxis(jnp.asarray(pairs), 0, 2)  # [n_sl, d, F, ...]
-            out = sketchmod.sketch_output(fld, pairs, r, rands)
-            if last:
-                trip, mk, mk2 = ks.triples_last, ks.mac_key_last, ks.mac_key2_last
-            else:
-                trip = mpc.level_slab(ks.triples, dpf_level)
-                mk, mk2 = ks.mac_key, ks.mac_key2
-            mk = jnp.expand_dims(jnp.asarray(mk), 1)  # broadcast over dims
-            mk2 = jnp.expand_dims(jnp.asarray(mk2), 1)
-            state = sketchmod.mul_state(fld, out, mk, mk2, trip)
-            # one stacked array = one device fetch + one wire message
-            # fhh-lint: disable=host-sync-in-hot-loop,chunked-device-readback (wire fetch: the
-            # exchange below needs host bytes; one fetch per round trip)
-            cshare = np.asarray(jnp.stack(mpc.cor_share(fld, state)))
-            peer_cs = await self._swap(cs, cshare)
-            pair_cs = (
-                (cshare, peer_cs) if self.server_id == 0
-                else (peer_cs, cshare)
+            cs.obs.count(
+                "device_fetches", 1 if ss is None else ss.k, level=level
             )
-            opened = mpc.cor(fld, (pair_cs[0][0], pair_cs[0][1]),
-                             (pair_cs[1][0], pair_cs[1][1]))
-            # fhh-lint: disable=host-sync-in-hot-loop,chunked-device-readback (wire fetch, as above)
-            o = np.asarray(
-                mpc.out_share(fld, bool(self.server_id), state, opened)
+            # cor exchange: per-shard D2H copies assembled positionally
+            # into ONE wire message (sketch_shard.wire starts the DMAs)
+            cor_np = await asyncio.to_thread(sketch_shard.wire, cor)
+            peer_cor = await self._swap(cs, cor_np)
+            o = sketch_shard.out_shares(
+                ss, fld, state, cor, peer_cor, bool(self.server_id)
             )
-            peer_o = await self._swap(cs, o)
-            # verdicts stay ON DEVICE inside the loop; fetching per batch
-            # cost one round trip per `bs` clients (fhh-lint caught it)
-            ok_parts.append(mpc.verify(fld, o, peer_o))  # [n_sl, d]
-        if ok_parts:
-            # fhh-lint: disable=host-sync-in-hot-loop (one post-loop readback)
-            ok_nd = np.asarray(jnp.concatenate(ok_parts, axis=0))  # [n, d]
-            ok = ok_nd.all(axis=1)
-        else:  # n == 0: nothing to verify
-            ok = np.ones(n, bool)
+            cs.obs.count(
+                "device_fetches", 1 if ss is None else ss.k, level=level
+            )
+            o_np = await asyncio.to_thread(sketch_shard.wire, o)
+            peer_o = await self._swap(cs, o_np)
+            ok_dev = sketch_shard.verdicts(ss, fld, o, peer_o)
+            # the level's SINGLE post-level readback: the verdict vector
+            ok = await _fetch(ok_dev, cs.obs, level=level)
         if level != 0:
             # one-shot within a boot: each stored depth's pairs open once;
             # a same-boot duplicate call is answered by the session dedup
@@ -1320,16 +1344,29 @@ class CollectorServer:
         False, "overloaded": True, scope, retry_after_s}`` (retryable:
         the client's RetryPolicy backs off and re-attempts)."""
         cs = cs if cs is not None else self._default()
-        if self.cfg.malicious:
-            raise RuntimeError(
-                "streaming ingest does not carry sketch material yet — "
-                "malicious mode uses the batch add_keys path"
-            )
         window = int(req["window"])
         sub_id = str(req["sub_id"])
         # fhh-lint: disable=chunked-device-readback (wire input: pickled host numpy, no device involved)
         chunk = tuple(np.asarray(a) for a in req["keys"])
         n_keys = int(chunk[0].shape[0])
+        # malicious mode: the client's sketch material (MAC'd payload
+        # DPFs + triples) rides the SAME entry tuple — the pool's slot
+        # semantics (append, reservoir replace) and the checkpoint's
+        # generic leaf flattening then cover it for free, and
+        # window_load splits the leaves back apart
+        if req.get("sketch") is not None:
+            if not self.cfg.malicious:
+                raise RuntimeError(
+                    "sketch material submitted to a semi-honest "
+                    "collector (cfg.malicious is off)"
+                )
+            # fhh-lint: disable=chunked-device-readback (wire input: pickled host numpy, no device involved)
+            chunk = chunk + tuple(np.asarray(a) for a in req["sketch"])
+        elif self.cfg.malicious:
+            raise RuntimeError(
+                "malicious mode: submit_keys requires the client's "
+                "sketch chunk alongside its keys"
+            )
         pool = cs.ingest_pool(window)
         cs.obs.count("pool_submits")
         prev = pool.verdicts.get(sub_id)
@@ -1373,7 +1410,31 @@ class CollectorServer:
         pool = cs._ingest_pools.get(w)
         if pool is None:
             pool = cs.ingest_pool(w)  # sealing an idle window is legal
+        want_root = req.get("sk_root")
+        if want_root is not None:
+            # recovery re-seal: the driver hands back the ORIGINAL
+            # window root it banked at first seal, so a journal-rebuilt
+            # pool (restarted server, no usable ingest checkpoint)
+            # still commits the identical challenge root — never a
+            # fresh one (same slabs under a new root = <r - r', x>)
+            want_root = np.array(want_root, np.uint32)
+            if pool.sk_root is None:
+                pool.sk_root = want_root
+            elif not np.array_equal(pool.sk_root, want_root):
+                raise RuntimeError(
+                    f"window_seal: window {w} already committed a "
+                    "different sketch root (recovery replayed stats "
+                    "from another life?)"
+                )
         if not pool.sealed:
+            if self.cfg.malicious and pool.sk_root is None:
+                # the window's coin-flip commitment: derived from the
+                # SESSION coin flip + window id at the seal boundary,
+                # then carried by the seal stats and every ingest
+                # checkpoint — the per-window twin of the batch path's
+                # tree_init root commit
+                await self._ensure_session_plane(cs)
+                pool.sk_root = sketchmod.window_root(cs._sketch_seed, w)
             pool.sealed = True
             # the seal instant starts this window's seal-to-hitters SLO
             # clock (observed at final_shares of the crawl that loads it)
@@ -1407,8 +1468,40 @@ class CollectorServer:
             raise RuntimeError(f"window_load: window {w} is not sealed")
         if not pool.entries:
             raise RuntimeError(f"window_load: window {w} admitted no keys")
-        cs.keys_parts = [IbDcfKeyBatch(*e) for e in pool.entries]
-        cs.clear_crawl_state()
+        nk = len(IbDcfKeyBatch._fields)
+        has_sketch = len(pool.entries[0]) > nk
+        # every refusal BEFORE any state mutates (the PR-4 contract): a
+        # half-loaded window must never leave the session with this
+        # window's sketch material but no committed root — a later
+        # tree_init would commit the live coin flip instead, and a
+        # retried window would re-open the same Beaver slabs under a
+        # different challenge (<r - r', x>)
+        if self.cfg.malicious and not has_sketch:
+            raise RuntimeError(
+                f"window_load: malicious mode but window {w} carries no "
+                "sketch material (submitted before cfg.malicious?)"
+            )
+        if has_sketch and pool.sk_root is None:
+            raise RuntimeError(
+                f"window_load: window {w} carries sketch material "
+                "but no committed challenge root (sealed by a "
+                "pre-sketch server?)"
+            )
+        cs.clear_crawl_state()  # per-window sketch state clears with it
+        cs.keys_parts = [IbDcfKeyBatch(*e[:nk]) for e in pool.entries]
+        if has_sketch:
+            # the sketch leaves ride each entry tuple (submit_keys
+            # appended them): split them back into upload-chunk form and
+            # install the window's committed challenge root for the
+            # coming tree_init — a recovered window re-loads the SAME
+            # root from the restored pool, so its re-run replays the
+            # identical challenge sequence
+            treedef = jax.tree.structure(_SKETCH_TREEDEF)
+            cs._sketch_parts = [
+                jax.tree.unflatten(treedef, list(e[nk:]))
+                for e in pool.entries
+            ]
+            cs._window_sketch_root = np.array(pool.sk_root, np.uint32)
         cs._window_seal_ts = pool.sealed_at  # seal-to-hitters SLO clock
         for old in [k for k in cs._ingest_pools if k < w]:
             del cs._ingest_pools[old]
@@ -1418,8 +1511,12 @@ class CollectorServer:
             collection=cs.key,
             window=w,
             keys=pool.keys,
+            sketch=has_sketch,
         )
-        return {"window": w, "keys": pool.keys, "subs": len(pool.entries)}
+        return {
+            "window": w, "keys": pool.keys, "subs": len(pool.entries),
+            "sketch": has_sketch,
+        }
 
     # -- resilience verbs (no reference analogue: the reference's only
     # recovery verb is reset, server.rs:64-69) ---------------------------
@@ -1530,6 +1627,11 @@ class CollectorServer:
             "kernel_gather_seconds": round(
                 cs.obs.timer_seconds("kernel_gather"), 6
             ),
+            # malicious-secure sketch verify layout (parallel/
+            # sketch_shard.py): the last verify's active shard count
+            # (None before any sketch level; 1 = the single fused
+            # program / the meshless path)
+            "sketch_shards": cs.obs.gauge_value("sketch_shards"),
         }
 
     async def tree_checkpoint(self, req, cs: CollectionSession | None = None) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the session's verb lock; sanitizer-validated)
@@ -1977,6 +2079,10 @@ class CollectorServer:
             mesh_shards,
             int(self.cfg.secure_kernel_shards),
             cs.planar(),
+            # malicious lane: the sketch verify ladder compiles its own
+            # fused per-bucket programs, sharded by the sketch plan
+            bool(cs._sketch_parts) or cs._sketch is not None,
+            int(self.cfg.sketch_shards),
         )
 
     def _warm_bucket(self, cs: CollectionSession, fb: int, L: int,
@@ -2060,8 +2166,70 @@ class CollectorServer:
                             packed, packed, masks, alive, fr.alive
                         )
                     )
+        if cs._sketch_parts or cs._sketch is not None:
+            # malicious lane: compile the fused sketch-verify ladder at
+            # this bucket rung (and, once per batch, the level-0
+            # full-width check) so a warmed malicious crawl dispatches
+            # zero fresh programs
+            self._warm_sketch(cs, fb, L)
         tenancy.mark_warmed(ladder_key)
         return True
+
+    def _warm_sketch(self, cs: CollectionSession, fb: int, L: int) -> None:
+        """Compile every device program the malicious verify lane
+        dispatches at frontier bucket ``fb``: the frontier-following
+        advance (``advance_sketch``'s eval_bit + liveness gating at the
+        bucket shape, both fields), the level-0 full-width check (root
+        eval_bit at the batch shape + the m = 2 verify chain), and the
+        fused sharded cor/out/verdict chain at m = ``fb`` for FE62
+        (inner levels) and F255 (the leaf) — all on throwaway inputs,
+        never the live sketch state, with the wire arrays
+        round-tripping through host numpy exactly like the live path
+        (sketch_shard.warm_verify)."""
+        if cs._sketch is None:
+            cs.concat_sketch()
+        k = cs._sketch.key
+        n, d = k.root_seed.shape[0], k.root_seed.shape[1]
+        ss = self._sketch_bind(cs, n, d)
+        idx = bool(self.server_id)
+        # level-0 full-width check: root states + both children per dim
+        root = dpf.eval_init(k)
+        st0 = jax.tree.map(
+            lambda a: jnp.broadcast_to(a[None], (1,) + a.shape), root
+        )
+        last0 = L == 1
+        fld0 = F255 if last0 else FE62
+        cw0 = dpf.level_cw(k, 0)
+        cwv0 = k.cw_val[..., 0, :] if not last0 else k.cw_val_last
+        st_r = jax.tree.map(lambda a: a[0], st0)
+        sides = [
+            dpf.eval_bit(
+                cw0, st_r, jnp.full((n, d), c), cwv0, k.key_idx, fld0,
+                sketchmod.LANES,
+            )[1]
+            for c in (False, True)
+        ]
+        jax.block_until_ready(jnp.stack(sides))
+        sketch_shard.warm_verify(ss, fld0, 2, n, d, idx)
+        if L == 1:
+            return  # data_len=1: the level-0 full check IS the leaf check
+        # frontier advance + per-rung verify, both fields (FE62 inner
+        # levels via tree_prune, F255 leaf via tree_prune_last)
+        parent = jnp.zeros(fb, jnp.int32)
+        direction = jnp.zeros((fb, 1, d), bool)
+        for last in (False, True):
+            fld = F255 if last else FE62
+            lvl = L - 1 if last else 0
+            cw = tuple(a[None] for a in dpf.level_cw(k, lvl))
+            cwv = (k.cw_val_last if last else k.cw_val[..., lvl, :])[None]
+            st = jax.tree.map(lambda a: a[parent], st0)
+            _, pair = dpf.eval_bit(
+                cw, st, direction, cwv, k.key_idx[None], fld,
+                sketchmod.LANES,
+            )
+            gate = jnp.ones((fb, 1, d) + (1,) * (pair.ndim - 3), bool)
+            jax.block_until_ready(jnp.where(gate, pair, 0))
+            sketch_shard.warm_verify(ss, fld, fb, n, d, idx)
 
     # -- wiring ----------------------------------------------------------
 

@@ -116,6 +116,7 @@ class _WindowPool:
     __slots__ = (
         "window", "wa", "entries", "verdicts", "keys",
         "admitted_keys", "shed_keys", "rejected", "sealed", "sealed_at",
+        "sk_root",
     )
 
     def __init__(self, window: int, wa: resadmission.WindowAdmission):
@@ -132,6 +133,11 @@ class _WindowPool:
         # seal-to-hitters SLO clock (observed at final_shares of the
         # crawl that loads the window — protocol/rpc.py)
         self.sealed_at: float | None = None
+        # malicious mode: the window's committed sketch-challenge root
+        # (uint32[4], sketch.window_root) — stamped at seal, carried by
+        # the seal stats and the ingest checkpoint so a recovered
+        # window's crawl replays the IDENTICAL challenge sequence
+        self.sk_root: np.ndarray | None = None
 
     def apply(self, sub_id: str, chunk: tuple,
               v: resadmission.Verdict) -> dict:
@@ -234,7 +240,7 @@ class _WindowPool:
         return resp
 
     def stats(self) -> dict:
-        return {
+        out = {
             "window": self.window,
             "sealed": self.sealed,
             "keys": self.keys,
@@ -243,6 +249,12 @@ class _WindowPool:
             "shed_keys": self.shed_keys,
             "rejected": self.rejected,
         }
+        if self.sk_root is not None:
+            # plain ints: the driver banks these stats and replays them
+            # into a recovery re-seal (dict equality in tests must stay
+            # unambiguous, so no ndarray values here)
+            out["sk_root"] = [int(x) for x in self.sk_root]
+        return out
 
 
 # Runtime twin of the fhh-race guard map — the "CollectionSession.*"
@@ -270,6 +282,7 @@ _SESSION_GUARDS = {
     "_sketch_parts": "_verb_lock",
     "_sketch_root": "_verb_lock",
     "_ratchet_digest": "_verb_lock",
+    "_window_sketch_root": "_verb_lock",
 }
 
 
@@ -335,6 +348,12 @@ class CollectionSession:
         self._sketch_seed: np.ndarray | None = None
         self._sketch_root: np.ndarray | None = None
         self._ratchet_digest: bytes | None = None
+        # streaming malicious mode: the LOADED window's committed
+        # challenge root (sketch.window_root, installed by window_load
+        # from the sealed pool) — tree_init commits it as the ratchet
+        # root instead of the raw session coin flip, so a recovered
+        # window replays the identical challenge; None = batch flow
+        self._window_sketch_root: np.ndarray | None = None
         # -- streaming ingest: PER-SESSION gate + pools --------------------
         # each collection gets its own admission controller (token
         # bucket, quotas, reservoir seed), so a flooding tenant exhausts
@@ -392,6 +411,7 @@ class CollectionSession:
         self._sketch_pairs_field = None
         self._sketch_root = None
         self._ratchet_digest = None
+        self._window_sketch_root = None
         self._ingest_pools.clear()  # a new collection's front door opens clean
         self._window_seal_ts = None
         self.ckpt_clear()  # a new collection must not resume an old one's
@@ -406,7 +426,10 @@ class CollectionSession:
 
     def clear_crawl_state(self) -> None:  # fhh-race: holds=_verb_lock (reached only from window_load/tree_restore, which run under this session's verb lock; sanitizer-validated)
         """Drop the crawl-plane state while leaving ingest pools and
-        checkpoints alone (``window_load``'s reset-to-fresh-batch)."""
+        checkpoints alone (``window_load``'s reset-to-fresh-batch).
+        The per-window SKETCH material clears with it: each window
+        carries its own client sketch keys and its own committed
+        challenge root (``window_load`` re-seeds both right after)."""
         self.keys = None
         self.alive_keys = None
         self.frontier = None
@@ -416,6 +439,16 @@ class CollectionSession:
         self._shard_last.clear()
         self._shard_level = None
         self._expand_ready.clear()
+        self._sketch_parts.clear()
+        self._sketch = None
+        self._sketch_states = None
+        self._sketch_pids = None
+        self._sketch_depth = 0
+        self._sketch_pairs = None
+        self._sketch_pairs_field = None
+        self._sketch_root = None
+        self._ratchet_digest = None
+        self._window_sketch_root = None
 
     def idle(self) -> bool:  # fhh-race: atomic (read-only probe from the serve-loop session bind; one event-loop slice)
         """True when nothing durable lives here (eviction candidate)."""
@@ -765,6 +798,13 @@ class CollectionSession:
                 # restart (the replayed seal verb is a no-op on an
                 # already-sealed pool and must not restamp the clock)
                 blob[f"ing{i}_sealed_at"] = np.float64(p.sealed_at)
+            if p.sk_root is not None:
+                # the window's committed sketch-challenge root: a
+                # recovered malicious window MUST replay the identical
+                # challenge (a restarted server's fresh plane coin flip
+                # would otherwise re-root the ratchet and turn the
+                # re-run's slab openings into a <r - r', x> leak)
+                blob[f"ing{i}_skroot"] = np.array(p.sk_root, np.uint32)
 
     @staticmethod
     def ingest_validate(z: dict, path: str) -> list | None:
@@ -844,6 +884,12 @@ class CollectionSession:
                     if f"ing{i}_sealed_at" in z
                     else None
                 ),
+                # optional (semi-honest windows / older blobs omit it)
+                "sk_root": (
+                    np.array(z[f"ing{i}_skroot"], np.uint32)
+                    if f"ing{i}_skroot" in z
+                    else None
+                ),
             })
         return parsed
 
@@ -860,6 +906,7 @@ class CollectionSession:
             pool = _WindowPool(w, wa)
             pool.sealed = bool(meta[1])
             pool.sealed_at = rec.get("sealed_at")
+            pool.sk_root = rec.get("sk_root")
             pool.keys = int(meta[2])
             pool.admitted_keys = int(meta[3])
             pool.shed_keys = int(meta[4])

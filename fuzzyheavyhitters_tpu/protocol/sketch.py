@@ -141,6 +141,26 @@ def ratchet_seed(root_seed, level: int, digest: bytes) -> np.ndarray:
     return np.frombuffer(h.digest()[:16], dtype="<u4").copy()
 
 
+_WINDOW_TAG = b"fhh-sketch-window-root/1"
+
+
+def window_root(session_seed, window: int) -> np.ndarray:
+    """A streaming window's ratchet root: uint32[4] =
+    SHA-256(tag ‖ session coin flip ‖ window)[:16].  Committed at
+    ``window_seal`` and CARRIED by the seal stats + the ingest
+    checkpoint, so a recovered window replays the IDENTICAL challenge
+    sequence even though a restarted server's plane handshake flipped a
+    fresh session coin — re-opening the window's Beaver slabs is then a
+    replay, never a second opening (the batch-path ratchet argument,
+    per window)."""
+    h = hashlib.sha256(_WINDOW_TAG)
+    h.update(np.ascontiguousarray(
+        np.asarray(session_seed, np.uint32)
+    ).tobytes())
+    h.update(struct.pack("<q", int(window)))
+    return np.frombuffer(h.digest()[:16], dtype="<u4").copy()
+
+
 class SketchKeyBatch(NamedTuple):
     """One party's sketch keys for N clients (ref: sketch.rs:14-24).
 
@@ -255,6 +275,85 @@ def shared_r_stream(field, shared_seed, level: int, m: int, n_rand: int):
         words[m * w :].reshape((n_rand, 3, w))
     )
     return r, rands
+
+
+# ---------------------------------------------------------------------------
+# Seek-by-offset challenge stream (the row-sharded verify's discipline)
+#
+# ``shared_r_stream`` draws the level's whole challenge stream — m per-node
+# words of r, then 3 rand rows per (client, dim) — from one CTR stream.
+# The device-resident sharded verify (parallel/sketch_shard.py) must hand
+# shard i EXACTLY its client slice of that stream without materializing
+# the rest, the same seek-by-offset discipline as
+# ``otext.sender_extend_rows``: the stream is CTR-mode, so any word range
+# is reachable by block offset + an intra-block slice.  Both helpers are
+# jit-safe with TRACED ``level``/``row0`` (one compiled program per
+# (m, batch) shape serves every level and every shard).
+# ---------------------------------------------------------------------------
+
+_STREAM_TAG = 0x5E71C  # shared_r_stream's level-domain word
+
+
+def _stream_seed(seed, level):
+    """The level's CTR seed — ``shared_r_stream``'s XOR, traced-level
+    safe."""
+    z = jnp.uint32(0)
+    return jnp.asarray(seed, jnp.uint32) ^ jnp.stack(
+        [z, z, jnp.uint32(_STREAM_TAG), jnp.asarray(level, jnp.uint32)]
+    )
+
+
+def challenge_r(field, seed, level, m: int):
+    """The level's shared per-node challenge vector r — words [0, m·w)
+    of the stream, identical on every shard (each derives it from the
+    replicated seed)."""
+    w = 8 if field.limb_shape else 4
+    words = prg.stream_words(_stream_seed(seed, level), m * w)
+    return field.sample(words.reshape(m, w))
+
+
+def challenge_rands(field, seed, level, m: int, row0, n_rows: int):
+    """Rows [row0, row0 + n_rows) of the level's rand1..3 table — the
+    seek-by-offset twin of ``shared_r_stream``'s tail draw (words
+    ``m·w + row0·3·w`` onward).  ``row0`` may be traced (the shard's
+    ``axis_index``-derived offset); the generated block window covers
+    any intra-block misalignment, and the slice is bit-identical to the
+    corresponding rows of the single-device stream by CTR construction.
+    (int32 word arithmetic: exact below ~2^31 stream words per level —
+    ~34 GB of challenge material, far past any real batch.)"""
+    w = 8 if field.limb_shape else 4
+    span = n_rows * 3 * w
+    word_lo = m * w + jnp.asarray(row0) * (3 * w)
+    blk0 = word_lo // 16
+    off = word_lo - blk0 * 16  # 0..15
+    nblk = (span + 15) // 16 + 1  # static bound: any off fits
+    blocks = prg.stream_blocks(
+        _stream_seed(seed, level), nblk, jnp.asarray(blk0, jnp.uint32)
+    )
+    words = jax.lax.dynamic_slice(blocks.reshape(nblk * 16), (off,), (span,))
+    return field.sample(words.reshape(n_rows, 3, w))
+
+
+def level_check_state(field, pairs, triples: mpc.TripleBatch, mac_key,
+                      mac_key2, seed, level, row0) -> mpc.MulStateBatch:
+    """One fused level's check state for a client slice: ``pairs``
+    field[m, n, d, LANES(, limbs)] value-pair shares over the level's m
+    nodes, per-client MAC shares [n(, limbs)], and the per-(client, dim,
+    check) triple slab.  ``row0`` is the slice's (client·dim)-row offset
+    into the level's challenge stream — 0 for the whole batch, the
+    shard's offset under ``shard_map`` — so a sharded call computes
+    EXACTLY its rows of the single-device state.  jit-safe (traced
+    ``level``/``row0``); the caller stacks :func:`mpc.cor_share` of the
+    result as the wire message."""
+    m, n, d = pairs.shape[0], pairs.shape[1], pairs.shape[2]
+    r = challenge_r(field, seed, level, m)
+    rands = challenge_rands(field, seed, level, m, row0, n * d)
+    rands = rands.reshape((n, d, 3) + field.limb_shape)
+    p = jnp.moveaxis(jnp.asarray(pairs), 0, 2)  # [n, d, m, LANES(, limbs)]
+    out = sketch_output(field, p, r, rands)
+    mk = jnp.expand_dims(jnp.asarray(mac_key), 1)  # broadcast over dims
+    mk2 = jnp.expand_dims(jnp.asarray(mac_key2), 1)
+    return mul_state(field, out, mk, mk2, triples)
 
 
 @partial(jax.jit, static_argnames=("field",))

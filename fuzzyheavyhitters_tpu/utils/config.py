@@ -5,8 +5,11 @@ The schema mirrors ``Config`` in the reference (ref: src/config.rs:5-16):
 zipf_exponent, server0, server1, distribution``.  The reference's shipped
 JSON files also carry ``sketch_batch_size`` / ``sketch_batch_size_last``
 keys that its parser ignores (config.rs vs src/bin/config.json:9-10); here
-they are live: protocol/rpc.py's ``sketch_verify`` chunks the client axis
-by them (the *_last knob covers the 8-limb F255 level).
+they are live in the spec helper (protocol/sketch.py ``verify_level``
+chunks the client axis by them; the *_last knob covers the 8-limb F255
+level).  The production verify (protocol/rpc.py ``sketch_verify``) runs
+the whole level as ONE fused device program — sharded by
+``sketch_shards`` below — so the host chunking knobs no longer gate it.
 
 Extra TPU-native knobs (all defaulted so reference configs load unchanged):
 
@@ -110,6 +113,16 @@ class Config:
     # to the gather path — instead of failing.  The wire is byte-
     # identical at every setting (asserted in tier-1).
     secure_kernel_shards: int = 0
+    # malicious-secure SKETCH verify sharding (parallel/sketch_shard.py):
+    # how many of the server's data-mesh devices the per-level check
+    # batch (the three MAC/square checks per (client, dim)) shards over.
+    # 0 = auto: follow the mesh's data shards.  1 pins the single fused
+    # program; N > 1 caps at N.  The ACTIVE count is the largest divisor
+    # of the client batch that fits, so a non-dividing batch degrades to
+    # fewer shards instead of failing.  The challenge stream, both wire
+    # messages, and the verdict vector are bit-identical at every
+    # setting (asserted in tier-1 and gated in bench_sketch).
+    sketch_shards: int = 0
     # per-level secure-kernel phase split (phase_otext/garble/eval/b2a
     # spans in the run report): True syncs the device at each phase
     # boundary so the spans carry real device time — the acceptance

@@ -132,6 +132,23 @@ assert mc.get("whole_level_speedup_vs_gathered") is not None, (
     "whole_level_speedup_vs_gathered missing (sharded-vs-gathered "
     "kernel comparison): " + last[:300]
 )
+skb = doc.get("extra", {}).get("sketch", {})
+assert skb.get("bit_identical"), (
+    "sketch section (malicious-secure verify: sharded legs gated "
+    "bit-identical to the unsharded path) missing from the compact "
+    "line: " + last[:300]
+)
+assert skb.get("malicious_overhead_vs_semi_honest") is not None, (
+    "sketch overhead headline (malicious_overhead_vs_semi_honest) "
+    "missing from the compact line: " + last[:300]
+)
+assert skb.get("sketch_clients_per_sec") is not None, (
+    "sketch clients_per_sec missing from the compact line: " + last[:300]
+)
+assert (skb.get("sketch_shards") or 0) >= 2, (
+    "sharded sketch legs never engaged (sketch_shards < 2 — the "
+    "row-sharded verify, parallel/sketch_shard.py): " + last[:300]
+)
 mt = doc.get("extra", {}).get("multitenant", {})
 assert mt.get("bit_identical_vs_solo"), (
     "multitenant section (per-collection sessions: bit-identity of "
@@ -155,6 +172,8 @@ print(
     f"(speedup_vs_gathered={mc['whole_level_speedup_vs_gathered']}), "
     f"multitenant_agg={mt['aggregate_clients_per_sec']} "
     f"(fill_ratio={mt['stall_fill_ratio']}), "
+    f"sketch_overhead={skb['malicious_overhead_vs_semi_honest']} "
+    f"(shards={skb['sketch_shards']}), "
     f"slo_level_p95_ms={slo['level_p95_ms']}, "
     f"seal_to_hitters_p95_s={islo['seal_to_hitters_p95_s']}, "
     f"line={len(last)}B, elapsed={doc.get('budget', {}).get('elapsed_s')}s"

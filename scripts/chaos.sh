@@ -12,7 +12,11 @@
 #     bit-identity, device-loss re-shard recovery), AND the multi-tenant
 #     suite (tests/test_sessions.py — N=4 concurrent collections
 #     bit-identical to solo, per-session gate isolation, the
-#     flood-A + kill/restart-s1 tenant-isolation leg),
+#     flood-A + kill/restart-s1 tenant-isolation leg), AND the
+#     malicious-sketch suite (tests/test_sketch_shard.py — the sharded
+#     verify bit-identity matrix and the WINDOWED-MALICIOUS recovery
+#     leg: kill/restart mid-window, the re-run replaying the identical
+#     committed challenge root),
 #     INCLUDING the slow-marked multi-fault storm tier-1 skips
 #   - writes a JSON artifact ({passed, failed, duration_s, tests}) to $1
 #     (default: chaos_report.json); exits non-zero on any failure
@@ -30,7 +34,7 @@ report="$(mktemp)"
 
 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_resilience.py tests/test_mesh_chaos.py tests/test_ingest.py \
-    tests/test_multichip.py tests/test_sessions.py \
+    tests/test_multichip.py tests/test_sessions.py tests/test_sketch_shard.py \
     -m "" -q \
     -p no:cacheprovider --junitxml="$report"
 rc=$?

@@ -24,7 +24,7 @@ from fuzzyheavyhitters_tpu.workloads import rides
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 N_REQS = 32
-PORT = 39701
+PORT = 21701
 CFG = {
     "data_len": 16,
     "n_dims": 2,

@@ -48,7 +48,7 @@ from fuzzyheavyhitters_tpu.utils import guards
 from fuzzyheavyhitters_tpu.utils.config import Config
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-BASE_PORT = 42331
+BASE_PORT = 24331
 
 RACE_RULE_NAMES = ("guarded-state-unlocked", "stale-read-across-await")
 

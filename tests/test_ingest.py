@@ -35,7 +35,7 @@ from fuzzyheavyhitters_tpu.resilience.chaos import ChaosProxy, parse_faults
 from fuzzyheavyhitters_tpu.utils import bits as bitutils
 from fuzzyheavyhitters_tpu.utils.config import Config
 
-BASE_PORT = 41231
+BASE_PORT = 23231
 
 
 @pytest.fixture(autouse=True)

@@ -30,7 +30,10 @@ from fuzzyheavyhitters_tpu.resilience.chaos import (
 from fuzzyheavyhitters_tpu.utils import bits as bitutils
 from fuzzyheavyhitters_tpu.utils.config import Config
 
-BASE_PORT = 43810
+# below the kernel's ephemeral source-port range (32768+) INCLUDING the
+# +8200 top offset: a leader-side client's ephemeral socket must never
+# land on a later test's hard-coded listener port (EADDRINUSE flakes)
+BASE_PORT = 23810
 
 L, N_CLIENTS, D = 5, 12, 1
 
@@ -347,6 +350,37 @@ def test_warmed_kernel_sharded_crawl_zero_fresh_compiles(kernel_keys):
     assert status0["mesh"]["kernel_shards"] >= 2  # the ladder engaged
     assert fresh == 0, (
         f"{fresh} fresh compiles in a warmed kernel-sharded crawl"
+    )
+
+
+def test_warmed_malicious_crawl_zero_fresh_compiles(client_keys,
+                                                    sketch_keys):
+    """The warmup contract extends to the MALICIOUS lane: after one
+    warmed malicious (sketch) crawl on the sharded mesh, a second
+    identically-shaped warmed crawl triggers ZERO fresh XLA compiles —
+    warmup compiles the fused sharded cor/out/verdict chain per bucket
+    rung, the level-0 full-width check, and the frontier-advance
+    programs the live verify dispatches (rpc._warm_sketch +
+    sketch_shard.warm_verify)."""
+    from fuzzyheavyhitters_tpu.utils import compile_cache
+
+    _, (k0, k1) = client_keys
+    sk0, sk1 = sketch_keys
+    port = BASE_PORT + 7000
+    kw = dict(server_data_devices=2)
+    _run(_cfg(port, **kw), port, k0, k1, sk0=sk0, sk1=sk1, warmup=True)
+    before = compile_cache.backend_compiles()
+    _, status0, rep = _run(
+        _cfg(port + 1200, **kw), port + 1200, k0, k1, sk0=sk0, sk1=sk1,
+        warmup=True,
+    )
+    fresh = compile_cache.backend_compiles() - before
+    # the sharded verify engaged (2 data devices -> 2 sketch shards)
+    assert status0["mesh"]["sketch_shards"] == 2
+    assert rep["sketch"]["sketch_shards"] == 2
+    assert rep["sketch"]["verify_seconds"] > 0
+    assert fresh == 0, (
+        f"{fresh} fresh compiles in a warmed malicious crawl"
     )
 
 

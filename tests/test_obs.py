@@ -398,7 +398,7 @@ def test_socket_run_report_two_servers_consistent(secure_exchange):
     byte counts are populated on BOTH sides, and one side's bytes sent
     equal the other's bytes received (same framed stream)."""
     L, n = 2, 12
-    port = 39871 if secure_exchange else 39851
+    port = 21871 if secure_exchange else 21851
     k0, k1 = _keys(L, n)
     cfg = Config(
         data_len=L, n_dims=1, ball_size=1, addkey_batch_size=8,
@@ -424,6 +424,14 @@ def test_socket_run_report_two_servers_consistent(secure_exchange):
         await asyncio.gather(c0.call("reset"), c1.call("reset"))
         await lead.upload_keys(k0, k1)
         res = await lead.run(n)
+        # close everything: a leaked listener (held alive by reference
+        # cycles until a gc pass) keeps its PORT bound for an arbitrary
+        # stretch of the suite — test_resilience's +220 scenario shares
+        # this port family and failed EADDRINUSE on exactly that
+        for c in (c0, c1):
+            await c.aclose()
+        for s in (s0, s1):
+            await s.aclose()
         return res, lead, s0, s1
 
     res, lead, s0, s1 = asyncio.run(run())

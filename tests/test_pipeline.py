@@ -29,7 +29,7 @@ from fuzzyheavyhitters_tpu.resilience.chaos import ChaosProxy, parse_faults
 from fuzzyheavyhitters_tpu.utils import bits as bitutils
 from fuzzyheavyhitters_tpu.utils.config import Config
 
-BASE_PORT = 38431
+BASE_PORT = 20431
 
 
 @pytest.fixture(autouse=True)
@@ -378,6 +378,17 @@ def test_bench_budget_and_compact_line(monkeypatch):
             "window_crawl_seconds": 4.2,
             "n_keys": 65536,
         },
+        "sketch": {
+            "malicious_overhead_vs_semi_honest": 1.31,
+            "sketch_clients_per_sec": 85.9,
+            "semi_honest_clients_per_sec": 112.5,
+            "bit_identical": True,
+            "sketch_shards": 8,
+            "verify_seconds": 0.412,
+            "clients_per_sec_by_shards": {"1": 60.1, "8": 85.9},
+            "skipped_shards": {},
+            "n_clients": 1024,
+        },
     }
     compact = bench._compact_extra(extra)
     assert "keygen_sweep" not in compact
@@ -394,6 +405,13 @@ def test_bench_budget_and_compact_line(monkeypatch):
     assert compact["ingest"]["ingest_keys_per_sec"] == 150000.0
     assert compact["ingest"]["bit_identical_vs_batch"] is True
     assert "report_ingest" not in compact["ingest"]
+    # the malicious-sketch section: overhead headline + rate + the
+    # bit-identity gate ride the line; the per-shard sweep stays out
+    assert compact["sketch"]["malicious_overhead_vs_semi_honest"] == 1.31
+    assert compact["sketch"]["sketch_clients_per_sec"] == 85.9
+    assert compact["sketch"]["bit_identical"] is True
+    assert compact["sketch"]["sketch_shards"] == 8
+    assert "clients_per_sec_by_shards" not in compact["sketch"]
     # the compact line stays far under the harness's stdout tail capture
     import json
 

@@ -23,7 +23,7 @@ from fuzzyheavyhitters_tpu.resilience.chaos import ChaosProxy, parse_faults
 from fuzzyheavyhitters_tpu.utils import bits as bitutils
 from fuzzyheavyhitters_tpu.utils.config import Config
 
-BASE_PORT = 39631
+BASE_PORT = 21631
 
 
 @pytest.fixture(autouse=True)

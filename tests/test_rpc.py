@@ -14,7 +14,7 @@ from fuzzyheavyhitters_tpu.protocol.leader_rpc import RpcLeader
 from fuzzyheavyhitters_tpu.utils import bits as bitutils
 from fuzzyheavyhitters_tpu.utils.config import Config
 
-BASE_PORT = 39131
+BASE_PORT = 21131
 
 
 @pytest.fixture(autouse=True)
@@ -34,8 +34,8 @@ def _cfg(**kw):
         num_sites=4,
         threshold=0.1,
         zipf_exponent=1.03,
-        server0="127.0.0.1:39131",
-        server1="127.0.0.1:39141",
+        server0="127.0.0.1:21131",
+        server1="127.0.0.1:21141",
         distribution="zipf",
         f_max=128,
     )
@@ -136,7 +136,7 @@ def test_error_response_propagates_and_connection_survives(rng):
     """A verb that fails server-side comes back as an __error__ response
     raising RuntimeError at the caller — and the connection stays usable
     (the error is a RESPONSE, not a transport death)."""
-    port = 39231
+    port = 21231
 
     async def flow():
         cfg = _cfg(
@@ -171,7 +171,7 @@ def test_read_loop_death_fails_inflight_futures():
     left dangling), and once redials exhaust, the call surfaces a
     ConnectionError — with the pending table empty (the send-failure /
     reader-death paths may not leak futures)."""
-    port = 39251
+    port = 21251
 
     async def flow():
         conns = []
@@ -209,7 +209,7 @@ def test_send_failure_pops_pending():
     """The _send-raises-mid-write path: the pending future is dropped so
     _pending cannot grow across failed calls (it used to leak one entry
     per failure), and a non-transport bug propagates unretried."""
-    port = 39261
+    port = 21261
 
     async def flow():
         async def hello_only(reader, writer):
@@ -247,7 +247,7 @@ def test_keepalive_sets_socket_options():
     not the kernel's ~2 h default)."""
     import socket
 
-    port = 39271
+    port = 21271
 
     async def flow():
         async def server(reader, writer):

@@ -221,7 +221,7 @@ def test_node_share_sums_gating(rng):
 # equalitytest.rs:222-266 — both roles in one process over a duplex pipe)
 # ---------------------------------------------------------------------------
 
-BASE_PORT = 39331
+BASE_PORT = 21331
 
 
 def _cfg(port_base=BASE_PORT, **kw):
@@ -256,9 +256,17 @@ async def _run_protocol(cfg, keys0, keys1, nreqs):
     c1 = await rpc.CollectorClient.connect(host1, port1)
     await asyncio.gather(t0, t1)
     lead = RpcLeader(cfg, c0, c1)
-    await asyncio.gather(c0.call("reset"), c1.call("reset"))
-    await lead.upload_keys(keys0, keys1)
-    return await lead.run(nreqs)
+    try:
+        await asyncio.gather(c0.call("reset"), c1.call("reset"))
+        await lead.upload_keys(keys0, keys1)
+        return await lead.run(nreqs)
+    finally:
+        # a leaked listener (held alive by reference cycles until a gc
+        # pass) keeps its port bound into LATER tests — close everything
+        for c in (c0, c1):
+            await c.aclose()
+        for s in (s0, s1):
+            await s.aclose()
 
 
 def _client_keys(rng, L, n):

@@ -22,7 +22,7 @@ from fuzzyheavyhitters_tpu.protocol.leader_rpc import RpcLeader
 from fuzzyheavyhitters_tpu.utils import bits as bitutils
 from fuzzyheavyhitters_tpu.utils.config import Config
 
-BASE_PORT = 39531
+BASE_PORT = 21531
 
 
 @pytest.fixture(autouse=True)

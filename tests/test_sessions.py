@@ -33,7 +33,7 @@ from fuzzyheavyhitters_tpu.protocol.leader_rpc import (
 from fuzzyheavyhitters_tpu.resilience import policy as respolicy
 from fuzzyheavyhitters_tpu.utils.config import Config
 
-BASE_PORT = 44431
+BASE_PORT = 26431
 
 
 @pytest.fixture(autouse=True)

@@ -152,7 +152,7 @@ def test_triple_verify_catches_bad_product(rng):
 # over the full two-server RPC protocol, sketch_batch_size=100000
 # ---------------------------------------------------------------------------
 
-BASE_PORT = 39531
+BASE_PORT = 21531
 
 
 def _run_rpc_protocol(cfg, k0, k1, sk0, sk1, n, port):
@@ -170,10 +170,20 @@ def _run_rpc_protocol(cfg, k0, k1, sk0, sk1, n, port):
         c1 = await rpc.CollectorClient.connect("127.0.0.1", port + 10)
         await asyncio.gather(t0, t1)
         lead = RpcLeader(cfg, c0, c1)
-        await asyncio.gather(c0.call("reset"), c1.call("reset"))
-        await lead.upload_keys(k0, k1, sk0, sk1)
-        res = await lead.run(n)
-        return res, s0.alive_keys.copy()
+        try:
+            await asyncio.gather(c0.call("reset"), c1.call("reset"))
+            await lead.upload_keys(k0, k1, sk0, sk1)
+            res = await lead.run(n)
+            alive = s0.alive_keys.copy()
+        finally:
+            # a leaked listener (kept alive by reference cycles until a
+            # gc pass) holds its port bound into LATER tests — close
+            # everything before the loop goes away
+            for c in (c0, c1):
+                await c.aclose()
+            for s in (s0, s1):
+                await s.aclose()
+        return res, alive
 
     return asyncio.run(run())
 
@@ -221,10 +231,10 @@ def test_multidim_malicious_e2e_excluded(rng):
     cfg = Config(
         data_len=L, n_dims=d, ball_size=1, addkey_batch_size=12, num_sites=4,
         threshold=0.5, zipf_exponent=1.03,
-        server0="127.0.0.1:39571", server1="127.0.0.1:39581",
+        server0="127.0.0.1:21571", server1="127.0.0.1:21581",
         distribution="zipf", f_max=64, sketch_batch_size=100_000,
     )
-    res, alive = _run_rpc_protocol(cfg, k0, k1, sk0, sk1, n, 39571)
+    res, alive = _run_rpc_protocol(cfg, k0, k1, sk0, sk1, n, 21571)
     want_alive = np.ones(n, bool)
     want_alive[3] = False
     np.testing.assert_array_equal(alive, want_alive)
@@ -260,11 +270,11 @@ def test_secure_plus_malicious_e2e(rng):
     cfg = Config(
         data_len=L, n_dims=1, ball_size=1, addkey_batch_size=12, num_sites=4,
         threshold=0.5, zipf_exponent=1.03,
-        server0="127.0.0.1:39591", server1="127.0.0.1:39601",
+        server0="127.0.0.1:21591", server1="127.0.0.1:21601",
         distribution="zipf", f_max=32, sketch_batch_size=100_000,
         secure_exchange=True,
     )
-    res, alive = _run_rpc_protocol(cfg, k0, k1, sk0, sk1, n, 39591)
+    res, alive = _run_rpc_protocol(cfg, k0, k1, sk0, sk1, n, 21591)
     want_alive = np.ones(n, bool)
     want_alive[3] = False
     np.testing.assert_array_equal(alive, want_alive)

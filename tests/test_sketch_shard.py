@@ -1,0 +1,408 @@
+"""Row-sharded device-resident sketch verify + streaming sketch windows.
+
+The malicious-secure fast lane's acceptance surface (parallel/
+sketch_shard.py + protocol/rpc.py sketch_verify + the windowed sketch
+material):
+
+- the BIT-IDENTITY MATRIX: at sketch shards {1, 2, 4, 8} × {FE62, F255}
+  (including a non-dividing client batch that degrades), the trusted
+  challenge stream, the cor-share wire, the out-share wire, and the
+  verdict vector are all byte/bit-identical between the sharded
+  shard_map programs and the single fused program — the gate that
+  catches a CTR-seek bug end-to-end results cannot (honest clients pass
+  under ANY challenge);
+- the WINDOWED MALICIOUS e2e: submit_keys carries sketch material,
+  window_seal commits a per-window challenge root, crawl_window runs
+  the malicious level loop — the cheater is excluded and the results
+  are bit-exact vs a batch malicious crawl over the same admitted set;
+- the KILL/RESTART recovery leg: server 1 killed and restarted
+  mid-window-crawl — the recovered window re-runs under the IDENTICAL
+  committed challenge root (re-opening its Beaver slabs is a replay,
+  never a second opening), results bit-exact, recovery counters in the
+  report.
+
+Shapes mirror tests/test_ingest.py (L=5, d=1) so the crawl kernels
+compile once across the suites.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fuzzyheavyhitters_tpu.obs import report as obsreport
+from fuzzyheavyhitters_tpu.ops import ibdcf
+from fuzzyheavyhitters_tpu.ops.fields import F255, FE62
+from fuzzyheavyhitters_tpu.parallel import sketch_shard
+from fuzzyheavyhitters_tpu.protocol import mpc, rpc, sketch
+from fuzzyheavyhitters_tpu.protocol.leader_rpc import (
+    RpcLeader,
+    WindowedIngest,
+)
+from fuzzyheavyhitters_tpu.utils import bits as bitutils
+from fuzzyheavyhitters_tpu.utils.config import Config
+
+BASE_PORT = 26810
+
+
+@pytest.fixture(autouse=True)
+def _module_cpu(cpu_default):
+    """CPU backend (the 8 virtual host devices exist for the shard
+    legs; see conftest)."""
+    yield
+
+
+def _devs(k):
+    return tuple(jax.devices("cpu")[:k])
+
+
+# ---------------------------------------------------------------------------
+# The bit-identity matrix: sharded vs single fused program
+# ---------------------------------------------------------------------------
+
+
+def test_binding_degrades_on_non_dividing_batch():
+    """The active shard count is the largest divisor of the client
+    batch <= the budget — a non-dividing batch degrades, never fails,
+    and a one-shard binding collapses to the single-program path."""
+    assert sketch_shard.sketch_shards(16, 8) == 8
+    assert sketch_shard.sketch_shards(12, 8) == 6  # 8 ∤ 12 -> 6
+    assert sketch_shard.sketch_shards(13, 8) == 1  # prime -> 1
+    ss = sketch_shard.bind(_devs(8), 12, 1, 8)
+    assert ss is not None and ss.k == 6
+    assert sketch_shard.bind(_devs(8), 13, 1, 8) is None
+    assert sketch_shard.bind(_devs(8), 16, 1, 1) is None
+
+
+@pytest.mark.parametrize("field", [FE62, F255], ids=["FE62", "F255"])
+def test_challenge_stream_bit_identical_matrix(rng, field):
+    """Shard i derives EXACTLY its rows of the single-device challenge
+    stream (r replicated, rand rows by CTR seek) at shards {2, 4, 8}
+    and on a non-dividing batch — byte-identical to the
+    ``shared_r_stream`` reference draw."""
+    seed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    N, d, m, level = 16, 2, 8, 3
+    r_ref, rands_ref = sketch.shared_r_stream(field, seed, level, m, N * d)
+    r_ref, rands_ref = np.asarray(r_ref), np.asarray(rands_ref)
+    r1, ra1 = sketch_shard.stream_parts(None, field, seed, level, m, N, d)
+    np.testing.assert_array_equal(r_ref, r1)
+    np.testing.assert_array_equal(rands_ref, ra1)
+    for k in (2, 4, 8):
+        ss = sketch_shard.bind(_devs(k), N, d, k)
+        assert ss is not None and ss.k == k
+        rk, rak = sketch_shard.stream_parts(ss, field, seed, level, m, N, d)
+        np.testing.assert_array_equal(r_ref, rk, err_msg=f"k={k}")
+        np.testing.assert_array_equal(rands_ref, rak, err_msg=f"k={k}")
+    # non-dividing batch: 8-device budget degrades to 6 shards and the
+    # stream still matches its own single-program reference
+    N2 = 12
+    ss = sketch_shard.bind(_devs(8), N2, d, 8)
+    assert ss.k == 6
+    _, ra_ref2 = sketch.shared_r_stream(field, seed, level, m, N2 * d)
+    _, ra2 = sketch_shard.stream_parts(ss, field, seed, level, m, N2, d)
+    np.testing.assert_array_equal(np.asarray(ra_ref2), ra2)
+
+
+@pytest.mark.parametrize("field", [FE62, F255], ids=["FE62", "F255"])
+def test_cor_out_verdict_wire_bit_identical_matrix(rng, field):
+    """Both wire messages and the verdict vector are byte/bit-identical
+    between the sharded and single fused programs, for honest states
+    AND a tampered one (the verdict flip itself must agree)."""
+    seed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    N, d, m, level = 16, 1, 4, 2
+    w = 8 if field.limb_shape else 4
+
+    def rnd(shape):
+        return field.sample(jnp.asarray(
+            rng.integers(0, 2**32, size=shape + (w,), dtype=np.uint32)
+        ))
+
+    t0, t1 = mpc.gen_triples(field, (N, d, mpc.CHECKS), seed)
+    pairs0, pairs1 = rnd((m, N, d, 2)), rnd((m, N, d, 2))
+    mk0, mk1 = rnd((N,)), rnd((N,))
+    mk = field.add(mk0, mk1)
+    k2 = field.mul(mk, mk)
+    mk2_0 = rnd((N,))
+    mk2_1 = field.sub(k2, mk2_0)
+
+    def party(ss, pairs, trip, a, a2, idx, peer_cor=None, peer_o=None):
+        cor, st = sketch_shard.cor_state(
+            ss, field, pairs, trip, a, a2, seed, level
+        )
+        cw = sketch_shard.wire(cor)
+        if peer_cor is None:
+            return cor, st, cw
+        o = sketch_shard.out_shares(ss, field, st, cor, peer_cor, idx)
+        ow = sketch_shard.wire(o)
+        if peer_o is None:
+            return o, ow
+        ok = sketch_shard.verdicts(ss, field, o, peer_o)
+        return np.asarray(ok), ow
+
+    def run(ss):
+        c0, s0, cw0 = party(ss, pairs0, t0, mk0, mk2_0, False)
+        c1, s1, cw1 = party(ss, pairs1, t1, mk1, mk2_1, True)
+        o0 = sketch_shard.out_shares(ss, field, s0, c0, cw1, False)
+        o1 = sketch_shard.out_shares(ss, field, s1, c1, cw0, True)
+        ow0, ow1 = sketch_shard.wire(o0), sketch_shard.wire(o1)
+        ok0 = np.asarray(sketch_shard.verdicts(ss, field, o0, ow1))
+        ok1 = np.asarray(sketch_shard.verdicts(ss, field, o1, ow0))
+        np.testing.assert_array_equal(ok0, ok1)
+        return cw0, cw1, ow0, ow1, ok0
+
+    ref = run(None)
+    for k in (2, 4, 8):
+        ss = sketch_shard.bind(_devs(k), N, d, k)
+        got = run(ss)
+        for a, b, what in zip(
+            ref, got, ("cor0", "cor1", "out0", "out1", "verdict")
+        ):
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"{what} diverged at k={k}"
+            )
+    # non-dividing batch: slice the inputs to N=12 (degrades to k=6)
+    sl = slice(0, 12)
+    pairs0_s = jax.tree.map(lambda a: a[:, sl], pairs0)
+    pairs1_s = jax.tree.map(lambda a: a[:, sl], pairs1)
+    t0_s = jax.tree.map(lambda a: a[sl], t0)
+    t1_s = jax.tree.map(lambda a: a[sl], t1)
+    # the closures in run()/party() read these at call time
+    pairs0, pairs1, t0, t1 = pairs0_s, pairs1_s, t0_s, t1_s
+    mk0, mk1 = mk0[sl], mk1[sl]
+    mk2_0, mk2_1 = mk2_0[sl], mk2_1[sl]
+    ref = run(None)
+    ss = sketch_shard.bind(_devs(8), 12, d, 8)
+    assert ss.k == 6
+    got = run(ss)
+    for a, b in zip(ref, got):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Streaming sketch material: windowed malicious crawls
+# ---------------------------------------------------------------------------
+
+L, N = 5, 12
+
+
+def _cfg(port_base, **kw):
+    defaults = dict(
+        data_len=L, n_dims=1, ball_size=1, addkey_batch_size=12,
+        num_sites=4, threshold=0.5, zipf_exponent=1.03,
+        server0=f"127.0.0.1:{port_base}",
+        server1=f"127.0.0.1:{port_base + 10}",
+        distribution="zipf", f_max=32, malicious=True,
+    )
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+def _material(rng):
+    """12 clients (8 clustered at 11), client 3's dim-0 sketch payload
+    forged at level 2 — handed identically to both servers (the
+    additive-attack shape test_sketch pins)."""
+    pts = np.array([[11]] * 8 + [[25], [2], [50], [60]])
+    pts_bits = np.array(
+        [[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts]
+    )
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 1, rng, engine="np")
+    seeds = rng.integers(0, 2**32, size=(N, 2, 4), dtype=np.uint32)
+    cseed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    sk0, sk1 = sketch.gen(seeds, pts_bits[:, 0, :], FE62, F255, cseed)
+    bad = np.asarray(sk0.key.cw_val).copy()
+    bad[3, 0, 2, 0] = (int(bad[3, 0, 2, 0]) + 1) % FE62.P
+    j = jnp.asarray(bad)
+    sk0 = sk0._replace(key=sk0.key._replace(cw_val=j))
+    sk1 = sk1._replace(key=sk1.key._replace(cw_val=j))
+    return k0, k1, sk0, sk1
+
+
+def _chunk(k, sl):
+    return tuple(np.asarray(x)[sl] for x in k)
+
+
+def _sk_chunk(sk, sl):
+    return [np.asarray(x)[sl] for x in jax.tree.leaves(sk)]
+
+
+def _hitters(res):
+    return {
+        tuple(int(v) for v in r): int(c)
+        for r, c in zip(res.decode_ints(), res.counts)
+    }
+
+
+async def _start_servers(cfg, port, ckpt_dir=None):
+    s0 = rpc.CollectorServer(0, cfg, ckpt_dir=ckpt_dir)
+    s1 = rpc.CollectorServer(1, cfg, ckpt_dir=ckpt_dir)
+    t1 = asyncio.create_task(
+        s1.start("127.0.0.1", port + 10, "127.0.0.1", port + 11)
+    )
+    await asyncio.sleep(0.05)
+    t0 = asyncio.create_task(
+        s0.start("127.0.0.1", port, "127.0.0.1", port + 11)
+    )
+    await asyncio.gather(t0, t1)
+    return s0, s1
+
+
+async def _bring_up(cfg, port, ckpt_dir=None):
+    live = {}
+    live["s0"], live["s1"] = await _start_servers(cfg, port, ckpt_dir)
+    c0 = await rpc.CollectorClient.connect("127.0.0.1", port)
+    c1 = await rpc.CollectorClient.connect("127.0.0.1", port + 10)
+    lead = RpcLeader(cfg, c0, c1)
+    await lead._both("reset")
+    return lead, c0, c1, live
+
+
+async def _teardown(clients, live):
+    for c in clients:
+        await c.aclose()
+    for s in live.values():
+        await s.aclose()
+
+
+def _batch_malicious(cfg, port, k0, k1, sk0, sk1):
+    """Reference: the batch (upload_keys + run) malicious crawl every
+    windowed result must be bit-exact against."""
+
+    async def run():
+        lead, c0, c1, live = await _bring_up(cfg, port)
+        await lead.upload_keys(k0, k1, sk0, sk1)
+        res = await lead.run(N)
+        alive = live["s0"].alive_keys.copy()
+        await _teardown((c0, c1), live)
+        return res, alive
+
+    return asyncio.run(run())
+
+
+def test_windowed_malicious_e2e_cheater_excluded_bit_exact(rng):
+    """THE streaming-malicious contract: sketch material rides
+    submit_keys into the window pool, the sealed window carries its own
+    challenge-root commitment, crawl_window runs the malicious level
+    loop — the cheater is excluded through the liveness gate and the
+    results are bit-exact vs the batch malicious crawl."""
+    port = BASE_PORT
+    k0, k1, sk0, sk1 = _material(rng)
+    cfg = _cfg(port)
+
+    async def run():
+        lead, c0, c1, live = await _bring_up(cfg, port)
+        wi = WindowedIngest(lead, checkpoint=False)
+        for i in range(N):
+            await wi.submit(
+                f"c{i}", _chunk(k0, slice(i, i + 1)),
+                _chunk(k1, slice(i, i + 1)),
+                sk0_chunk=_sk_chunk(sk0, slice(i, i + 1)),
+                sk1_chunk=_sk_chunk(sk1, slice(i, i + 1)),
+            )
+        stats = await wi.seal_window()
+        res = await wi.crawl_window(0)
+        alive = live["s0"].alive_keys.copy()
+        st = await c0.call("status")
+        rep = obsreport.run_report(
+            [live["s0"].obs, live["s1"].obs, lead.obs, wi.obs]
+        )
+        await _teardown((c0, c1), live)
+        return res, alive, stats, st, rep
+
+    res, alive, stats, st, rep = asyncio.run(run())
+    # the sealed window committed a challenge root and announced it
+    assert "sk_root" in stats and len(stats["sk_root"]) == 4
+    want_alive = np.ones(N, bool)
+    want_alive[3] = False
+    np.testing.assert_array_equal(alive, want_alive)
+    want_res, want_alive_b = _batch_malicious(
+        _cfg(port + 40), port + 40, k0, k1, sk0, sk1
+    )
+    np.testing.assert_array_equal(alive, want_alive_b)
+    np.testing.assert_array_equal(res.counts, want_res.counts)
+    np.testing.assert_array_equal(res.paths, want_res.paths)
+    assert _hitters(res) == {(10,): 7, (11,): 7, (12,): 7}
+    # the report grew the sketch section (the fused verify ran)
+    assert rep["sketch"]["verify_seconds"] > 0
+    assert rep["sketch"]["levels_verified"] >= 2
+    # status surfaces the verify's shard layout (meshless here -> 1)
+    assert st["mesh"] is None or st["mesh"]["sketch_shards"] >= 1
+
+
+def test_windowed_malicious_kill_restart_replays_identical_challenge(
+    rng, tmp_path
+):
+    """THE recovery leg: server 1 killed + restarted MID-CRAWL of a
+    malicious window.  Recovery restores the ingest checkpoint (window
+    root included), replays the journal (sketch chunks included),
+    re-seals under the ORIGINAL root, and re-runs — the re-run replays
+    the identical challenge sequence (the committed root survives the
+    restart, so re-opening the window's Beaver slabs is a replay, never
+    a second opening), the cheater stays excluded, and the results are
+    bit-exact vs the fault-free batch crawl."""
+    port = BASE_PORT + 100
+    k0, k1, sk0, sk1 = _material(rng)
+    cfg = _cfg(port)
+    ck = tmp_path / "ck"
+    ck.mkdir()
+
+    async def run():
+        lead, c0, c1, live = await _bring_up(cfg, port, ckpt_dir=str(ck))
+        wi = WindowedIngest(lead)  # checkpointing ON
+        for i in range(N):
+            await wi.submit(
+                f"c{i}", _chunk(k0, slice(i, i + 1)),
+                _chunk(k1, slice(i, i + 1)),
+                sk0_chunk=_sk_chunk(sk0, slice(i, i + 1)),
+                sk1_chunk=_sk_chunk(sk1, slice(i, i + 1)),
+            )
+        stats = await wi.seal_window()
+        root_committed = np.array(stats["sk_root"], np.uint32)
+
+        async def assassin():
+            # kill s1 the moment the window crawl is underway
+            while live["s1"].frontier is None:
+                await asyncio.sleep(0.01)
+            await live["s1"].aclose()
+            await asyncio.sleep(0.3)
+            live["s1"] = rpc.CollectorServer(1, cfg, ckpt_dir=str(ck))
+            await live["s1"].start(
+                "127.0.0.1", port + 10, "127.0.0.1", port + 11
+            )
+
+        kill = asyncio.create_task(assassin())
+        res = await wi.crawl_window(0)
+        await kill
+        alive0 = live["s0"].alive_keys.copy()
+        alive1 = live["s1"].alive_keys.copy()
+        # the recovered crawl committed the ORIGINAL window root on
+        # BOTH servers — the restarted one included (the identical-
+        # challenge replay this test exists to pin)
+        roots = (
+            live["s0"]._default()._sketch_root.copy(),
+            live["s1"]._default()._sketch_root.copy(),
+        )
+        rep = obsreport.run_report(
+            [live["s0"].obs, live["s1"].obs, lead.obs, wi.obs]
+        )
+        await _teardown((c0, c1), live)
+        return res, alive0, alive1, root_committed, roots, rep
+
+    res, alive0, alive1, root_committed, roots, rep = asyncio.run(run())
+    want_alive = np.ones(N, bool)
+    want_alive[3] = False
+    np.testing.assert_array_equal(alive0, want_alive)
+    np.testing.assert_array_equal(alive1, want_alive)
+    for r in roots:
+        np.testing.assert_array_equal(r, root_committed)
+    want_res, _ = _batch_malicious(
+        _cfg(port + 40), port + 40, k0, k1, sk0, sk1
+    )
+    np.testing.assert_array_equal(res.counts, want_res.counts)
+    np.testing.assert_array_equal(res.paths, want_res.paths)
+    # the kill actually happened AND was recovered, visibly
+    ing = rep["registries"]["ingest"]["counters"]
+    assert ing["ingest_recoveries"]["total"] >= 1
+    assert ing["ingest_journal_replays"]["total"] >= 1

@@ -28,7 +28,7 @@ from fuzzyheavyhitters_tpu.resilience.chaos import ChaosProxy, parse_faults
 from fuzzyheavyhitters_tpu.utils import bits as bitutils
 from fuzzyheavyhitters_tpu.utils.config import Config
 
-BASE_PORT = 42731
+BASE_PORT = 24731
 
 
 @pytest.fixture(autouse=True)
