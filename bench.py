@@ -1857,10 +1857,74 @@ def bench_multitenant(n=1024, L=10, port=22531, tenant_counts=(1, 2, 4),
 # the SIGTERM handler dumps so a timed-out bench still reports them
 _PARTIAL: dict = {}
 
+# artifact path (--out).  The PARENT owns the file: _child_init clears
+# this in bench children so a TERMed child's last-gasp dump can never
+# clobber the parent's per-leg artifact (child telemetry travels on the
+# stdout contract instead, folded in by _subprocess_metric).
+_OUT: str | None = "bench_full.json"
+
+
+def _atomic_json(path: str, doc: dict) -> None:
+    """tmp + rename so a kill mid-write leaves the PREVIOUS artifact
+    intact, never a truncated JSON file — the whole point of writing
+    per leg is that the file on disk is valid at every instant."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def _write_leg_artifact() -> None:
+    """Crash-proof bench: every completed leg lands in the on-disk
+    artifact AS IT FINISHES, in the partial form (``"partial": true``
+    until main() closes the manifest with the final document).  A bench
+    killed at any point leaves a valid artifact carrying every leg that
+    completed, and ``--resume`` picks up from exactly there."""
+    if _OUT is None:
+        return
+    _atomic_json(_OUT, {
+        "partial": True,
+        "reason": "in-progress",
+        "results": dict(_PARTIAL),
+    })
+
+
+def _load_resume(path: str) -> dict:
+    """Previously-completed legs from an existing artifact: the partial
+    form's ``results`` or — resuming over a CLOSED manifest — the final
+    form's ``extra`` (mapping its ``secure_crawl`` key back to the
+    ``secure`` leg name the partial path uses)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(doc, dict):
+        return {}
+    if doc.get("partial"):
+        res = dict(doc.get("results") or {})
+    else:
+        res = dict(doc.get("extra") or {})
+        res.pop("reference_key_bytes", None)
+        if "secure_crawl" in res:
+            res["secure"] = res.pop("secure_crawl")
+        if "keygen_sweep" in res and "value" in doc:
+            res["keygen_headline"] = doc["value"]
+    sweep = res.get("keygen_sweep")
+    if isinstance(sweep, dict):
+        try:  # JSON round-trips the data_len keys as strings
+            res["keygen_sweep"] = {int(k): v for k, v in sweep.items()}
+        except (TypeError, ValueError):
+            pass
+    return res
+
 
 def _dump_partial(reason: str = "sigterm") -> dict:
     """Last-gasp artifact: finished sections plus the telemetry run
-    report — the FULL document goes to ``bench_full.json`` (and the
+    report — the FULL document goes to the ``--out`` artifact (and the
     telemetry to ``$FHH_RUN_REPORT`` when set); the LAST stdout line (the
     bench output contract) carries the COMPACT form, because the harness
     keeps only a short stdout tail and an oversized line parses as
@@ -1873,16 +1937,17 @@ def _dump_partial(reason: str = "sigterm") -> dict:
         "results": dict(_PARTIAL),
         "telemetry": obs.run_report(),
     }
-    try:
-        with open("bench_full.json", "w") as f:
-            json.dump(rep, f, indent=1)
-    except OSError:
-        pass
+    if _OUT is not None:
+        _atomic_json(_OUT, rep)
     compact = {
         "partial": True,
         "reason": reason,
         "results": _compact_extra(
-            {k: v for k, v in _PARTIAL.items() if k != "keygen_sweep"}
+            {
+                k: v
+                for k, v in _PARTIAL.items()
+                if k not in ("keygen_sweep", "keygen_headline")
+            }
         ),
         "sections_done": sorted(_PARTIAL),
     }
@@ -1951,6 +2016,23 @@ def _install_sigterm_partial() -> None:
     signal.signal(signal.SIGTERM, handler)
 
 
+def _child_init() -> None:
+    """Per-child preamble (prepended by _subprocess_metric): the SIGTERM
+    partial contract, plus the live /metrics exporter when
+    ``FHH_METRICS_PORT`` is set — the PARENT never binds (it only
+    orchestrates; the registries worth scraping live in the children,
+    which run serially so the base port never conflicts).  The child's
+    artifact path is cleared: its partial dump rides the stdout contract
+    only, never the parent's per-leg artifact file."""
+    global _OUT
+
+    _OUT = None
+    _install_sigterm_partial()
+    from fuzzyheavyhitters_tpu.obs import exporter
+
+    exporter.maybe_start("bench")
+
+
 def _subprocess_metric(code: str, timeout_s: int):
     """Run one benchmark in a child process with a hard timeout so a
     stalled accelerator tunnel (or a hung socket loop) can never take down
@@ -1961,7 +2043,7 @@ def _subprocess_metric(code: str, timeout_s: int):
     import subprocess
     import sys
 
-    code = "import bench; bench._install_sigterm_partial();" + code
+    code = "import bench; bench._child_init();" + code
     # $FHH_RUN_REPORT belongs to the PARENT: a TERMed child would write
     # the file too, and the parent's own exit dump then clobbers it.
     # Child telemetry travels on the stdout contract (last JSON line)
@@ -2117,7 +2199,37 @@ def _compact_extra(full_extra: dict) -> dict:
     return out
 
 
-def main():
+def main(argv=None):
+    global _OUT
+    import argparse
+
+    from fuzzyheavyhitters_tpu import obs
+
+    ap = argparse.ArgumentParser(
+        description="fuzzy-heavy-hitters benchmark suite"
+    )
+    ap.add_argument(
+        "--out", default="bench_full.json",
+        help="artifact path (written atomically after every leg)",
+    )
+    ap.add_argument(
+        "--resume", action="store_true",
+        help="skip legs already present in --out (skipped/errored legs "
+             "rerun); a closed manifest resumes too",
+    )
+    ap.add_argument(
+        "--sections", default=None,
+        help="comma list of leg names to run; the rest report "
+             '{"skipped": "sections"}',
+    )
+    args = ap.parse_args(argv)
+    _OUT = args.out
+    only = (
+        {s.strip() for s in args.sections.split(",") if s.strip()}
+        if args.sections
+        else None
+    )
+
     # one persistent compile cache shared by the parent and every child
     # section (the children inherit the env var): the per-bucket crawl
     # programs compile once per HLO, not once per subprocess — the
@@ -2128,34 +2240,74 @@ def main():
     )
     _compile_cache.enable()
     _install_sigterm_partial()
+    if args.resume:
+        _PARTIAL.update(_load_resume(_OUT))
+        if _PARTIAL:
+            obs.emit(
+                "bench.resume", path=_OUT,
+                legs=sorted(
+                    k for k in _PARTIAL
+                    if k not in ("keygen_sweep", "keygen_headline")
+                ),
+            )
     rng = np.random.default_rng(0)
-    if BENCH_SMOKE:
-        headline, sweep = bench_keygen_smoke(rng)
+    if (
+        args.resume
+        and "keygen_sweep" in _PARTIAL
+        and "keygen_headline" in _PARTIAL
+    ):
+        obs.emit("bench.leg", name="keygen", status="resume-skip")
+        headline = float(_PARTIAL["keygen_headline"])
+        sweep = _PARTIAL["keygen_sweep"]
     else:
-        import jax
-        import jax.numpy as jnp
+        obs.emit("bench.leg", name="keygen", status="run")
+        if BENCH_SMOKE:
+            headline, sweep = bench_keygen_smoke(rng)
+        else:
+            import jax
+            import jax.numpy as jnp
 
-        from fuzzyheavyhitters_tpu.ops import ibdcf
+            from fuzzyheavyhitters_tpu.ops import ibdcf
 
-        headline, sweep = bench_keygen(jax, jnp, ibdcf, rng)
-    _PARTIAL["keygen_sweep"] = sweep
+            headline, sweep = bench_keygen(jax, jnp, ibdcf, rng)
+        _PARTIAL["keygen_sweep"] = sweep
+        _PARTIAL["keygen_headline"] = round(headline, 1)
+        _write_leg_artifact()
 
     def section(name, code, timeout_s, smoke_code=None):
         """One subprocess section under the wall-clock budget: a section
         that cannot fit in the time left (reserve included) is skipped
-        with a marker instead of risking the whole artifact."""
-        if BENCH_SMOKE and smoke_code is None:
+        with a marker instead of risking the whole artifact.  Completed
+        legs land in the artifact immediately (_write_leg_artifact); on
+        --resume a leg already present (and not a skip/error marker)
+        returns its recorded result without rerunning."""
+        prev = _PARTIAL.get(name)
+        if (
+            args.resume
+            and prev is not None
+            and not (
+                isinstance(prev, dict)
+                and ("skipped" in prev or "error" in prev)
+            )
+        ):
+            obs.emit("bench.leg", name=name, status="resume-skip")
+            return prev
+        if only is not None and name not in only:
+            res = {"skipped": "sections"}
+        elif BENCH_SMOKE and smoke_code is None:
             res = {"skipped": "smoke"}
         else:
             rem = _budget_left() - _BUDGET_RESERVE_S
             if rem < 60:
                 res = {"skipped": "budget"}
             else:
+                obs.emit("bench.leg", name=name, status="run")
                 res = _subprocess_metric(
                     smoke_code if BENCH_SMOKE else code,
                     timeout_s=int(min(timeout_s, rem)),
                 )
         _PARTIAL[name] = res
+        _write_leg_artifact()
         return res
 
     # budget-trim order: the acceptance-critical secure sections run
@@ -2314,13 +2466,11 @@ def main():
         "smoke": BENCH_SMOKE,
     }
     full = dict(head, extra=extra, budget=budget_info)
-    # full artifact: a file (always) + the first stdout line (for humans
-    # and transcripts) — NOT the last line, which must stay parseable
-    try:
-        with open("bench_full.json", "w") as f:
-            json.dump(full, f, indent=1)
-    except OSError:
-        pass
+    # full artifact: closing the manifest — the atomic rewrite replaces
+    # the per-leg partial form (no "partial" key ever again) — plus the
+    # first stdout line (for humans and transcripts); NOT the last line,
+    # which must stay parseable
+    _atomic_json(_OUT, full)
     print(json.dumps(full), flush=True)
     # the LAST stdout line is the machine contract: the harness keeps a
     # short tail, so it gets the compact form (headline + per-section

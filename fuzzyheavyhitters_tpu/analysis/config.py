@@ -111,6 +111,8 @@ class LintConfig:
         "fuzzyheavyhitters_tpu/workloads/covid_data_visualization.py",
         # the linter's own CLI: stdout IS its program-output channel
         "fuzzyheavyhitters_tpu/analysis/cli.py",
+        # `ops top` live screen: stdout IS the rendered view
+        "fuzzyheavyhitters_tpu/obs/ops.py",
     )
     # unguarded-shared-state rule: modules whose module-level mutables
     # must only be written under a registered lock
@@ -161,6 +163,36 @@ class LintConfig:
         "fuzzyheavyhitters_tpu/protocol",
         "fuzzyheavyhitters_tpu/obs",
         "fuzzyheavyhitters_tpu/parallel",
+    )
+    # metric-naming rule: modules where registry metric names (literal
+    # first args of the metric_calls methods) must be valid Prometheus
+    # identifier chunks — lowercase ``[a-z][a-z0-9_]*`` with optional
+    # ``:sub`` parts (the exporter folds a colon into a ``key`` label) —
+    # and where a full ``fhh_...`` exported-series literal must end with
+    # a recognized unit suffix (Prometheus consumers key on the unit
+    # token; obs/exporter.py appends _total/_seconds itself, so only
+    # hand-rolled exposition literals need the suffix spelled out)
+    metric_modules: tuple = (
+        "fuzzyheavyhitters_tpu/obs",
+        "fuzzyheavyhitters_tpu/protocol",
+        "fuzzyheavyhitters_tpu/parallel",
+        "tests",
+    )
+    metric_calls: tuple = ("count", "gauge", "observe", "timer_add")
+    metric_unit_suffixes: tuple = (
+        "_total",
+        "_seconds",
+        "_bytes",
+        "_bucket",
+        "_sum",
+        "_count",
+        "_info",
+        "_ratio",
+        "_keys",
+        "_shards",
+        "_entries",
+        "_epoch",
+        "_active",
     )
     # fhh-race rules (analysis/concurrency.py): modules whose asyncio
     # lock discipline is analyzed interprocedurally — the server verb
@@ -302,6 +334,9 @@ def load_config(root: str | None = None, pyproject: str | None = None) -> LintCo
         "readback_modules",
         "queue_modules",
         "span_modules",
+        "metric_modules",
+        "metric_calls",
+        "metric_unit_suffixes",
         "race_modules",
         "default_paths",
     ):

@@ -1,6 +1,6 @@
 """The fhh-lint rule set, tuned to this codebase's invariants.
 
-Twelve rules over eleven concerns (the broad-except/bare-print concern
+Thirteen rules over twelve concerns (the broad-except/bare-print concern
 ships as two rules so suppressions and severities stay per-rule; the
 two interprocedural fhh-race rules live in :mod:`.concurrency` and are
 registered here):
@@ -68,6 +68,14 @@ registered here):
   telemetry inside jit-decorated bodies (runs at trace time: records
   once per compile, never per execution).  Scope ``span_modules``:
   protocol/, obs/, parallel/.
+- ``metric-naming`` — exported series names must be valid Prometheus
+  identifiers: literal metric names fed to the registry methods
+  (``count``/``gauge``/``observe``/``timer_add``) must be lowercase
+  ``[a-z][a-z0-9_]*`` chunks (optionally ``:sub``, folded into a
+  ``key`` label by obs/exporter.py), and a hand-rolled ``fhh_...``
+  series literal must end with a unit suffix (``_seconds``, ``_bytes``,
+  ``_total``, ...) — the live /metrics plane's naming contract,
+  enforced where the names are born instead of on the wire.
 - ``guarded-state-unlocked`` / ``stale-read-across-await`` — the
   fhh-race pair (:mod:`.concurrency`): interprocedural asyncio
   lock-discipline over the declared guard map
@@ -81,6 +89,7 @@ registered here):
 from __future__ import annotations
 
 import ast
+import re
 
 from .concurrency import RACE_RULES
 from .engine import Rule, SourceModule, dotted_name, last_segment
@@ -943,6 +952,98 @@ class UnboundedQueue(Rule):
         return None
 
 
+# ---------------------------------------------------------------------------
+# 11. metric-naming
+# ---------------------------------------------------------------------------
+
+# a registry metric name: lowercase identifier chunk, optional ":sub"
+# parts (the exporter folds a colon into a `key` label — obs/exporter.py)
+_METRIC_NAME_RE = re.compile(r"[a-z][a-z0-9_]*(?::[a-z0-9_]+)*")
+# identifier-LIKE: a literal that was plausibly meant as a metric name
+# but is invalid (camelCase, dashes, dots).  Literals outside this shape
+# (spaces, arbitrary punctuation) are substring-search arguments to
+# str.count()-style calls, never metric names — skipping them keeps the
+# rule zero-noise over the shared `count` method name.
+_METRIC_LIKE_RE = re.compile(r"[A-Za-z0-9_.:\-]+")
+# a hand-rolled exported-series literal (scrape parsers, exposition
+# producers); the exporter's own f-string assembly is out of scope
+# (JoinedStr fragments are never whole names)
+_EXPORTED_RE = re.compile(r"fhh_[a-z0-9_]+")
+
+
+class MetricNaming(Rule):
+    """Exported series names must be valid Prometheus identifiers.
+
+    Two checks over ``metric_modules``: (1) literal first arguments of
+    the registry metric methods (``metric_calls``) must match
+    ``[a-z][a-z0-9_]*`` with optional ``:sub`` parts — the exporter
+    prefixes ``fhh_`` and appends ``_total``/``_seconds`` itself, so a
+    conforming internal name IS a conforming series name; (2) a full
+    ``fhh_...`` string literal (a hand-rolled exposition line or scrape
+    key) must end with a recognized unit suffix
+    (``metric_unit_suffixes``), because Prometheus consumers key on the
+    unit token and a bare name reads as unitless."""
+
+    name = "metric-naming"
+    default_severity = "error"
+
+    def check(self, mod: SourceModule, cfg):
+        if not _under_prefix(mod.relpath, cfg.metric_modules):
+            return
+        # constants living inside f-strings are fragments, not names
+        joined: set[int] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.JoinedStr):
+                joined.update(id(v) for v in node.values)
+        suffixes = tuple(cfg.metric_unit_suffixes)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                yield from self._check_call(node, cfg)
+            elif (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and id(node) not in joined
+                and _EXPORTED_RE.fullmatch(node.value)
+                and not node.value.endswith(suffixes)
+            ):
+                yield (
+                    *_span(node),
+                    f"exported series literal {node.value!r} carries no "
+                    "unit suffix "
+                    f"({', '.join(suffixes[:4])}, ...) — Prometheus "
+                    "consumers key on the unit token; rename it, or "
+                    "suppress with a justification if it is not a "
+                    "series name",
+                )
+
+    def _check_call(self, node: ast.Call, cfg):
+        if not isinstance(node.func, ast.Attribute):
+            return
+        if node.func.attr not in cfg.metric_calls:
+            return
+        if not node.args:
+            return
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+            return
+        s = arg.value
+        if _METRIC_NAME_RE.fullmatch(s):
+            return
+        # not even identifier-like (spaces, lone punctuation, no letter):
+        # a substring-search argument, not a metric name attempt
+        if not _METRIC_LIKE_RE.fullmatch(s):
+            return
+        if len(s) < 2 or not any(c.isalpha() for c in s):
+            return
+        yield (
+            *_span(node),
+            f"metric name {s!r} is not a valid Prometheus identifier "
+            "chunk — use [a-z][a-z0-9_]* (optionally :sub, which the "
+            "exporter folds into a key label); uppercase, dashes, and "
+            "dots break the fhh_* exposition contract",
+        )
+
+
 ALL_RULES: tuple[Rule, ...] = (
     HostSyncInHotLoop(),
     SecretToSink(),
@@ -954,6 +1055,7 @@ ALL_RULES: tuple[Rule, ...] = (
     UnboundedAwait(),
     UnboundedQueue(),
     SpanDiscipline(),
+    MetricNaming(),
     # the interprocedural fhh-race pair (analysis/concurrency.py)
     *RACE_RULES,
 )

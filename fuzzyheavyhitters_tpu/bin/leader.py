@@ -238,6 +238,13 @@ async def _run(cfg, nreqs: int, rng) -> None:
 
 
 def main() -> None:
+    # /metrics exporter claims its port FIRST (before amain's arg
+    # validation) so even a leader that dies on a config error was
+    # scrapeable; bind failure degrades with a structured warn
+    # (obs.exporter — zero-cost when FHH_METRICS_PORT is unset)
+    obs.exporter.maybe_start("leader")
+    # fresh-compile telemetry: compiles attribute to the active phase
+    obs.devmem.install_compile_listener()
     # shared exit contract (obs.exit_report): SIGTERM -> SystemExit so the
     # run report is still written — a timed-out run leaves per-level
     # phase/byte accounting up to the level it died in (plus the

@@ -87,7 +87,34 @@ async def amain(cfg, server_id: int) -> None:
             await srv.serve_forever()
 
 
+def _peek_server_id(argv: list[str]) -> int | None:
+    """Cheap pre-parse of ``--server_id`` so the /metrics port claim can
+    happen FIRST (see main); full validation still belongs to get_args."""
+    for i, a in enumerate(argv):
+        if a == "--server_id" and i + 1 < len(argv):
+            try:
+                return int(argv[i + 1])
+            except ValueError:
+                return None
+        if a.startswith("--server_id="):
+            try:
+                return int(a.split("=", 1)[1])
+            except ValueError:
+                return None
+    return None
+
+
 def main() -> None:
+    import sys
+
+    # /metrics exporter claims its port FIRST — before arg validation —
+    # so a server that dies on a config error is still scrapeable for
+    # the seconds it lives, and a port conflict surfaces immediately at
+    # startup rather than after an expensive setup.  Bind failure is a
+    # structured warn, never a crash (the PR 1 report-path discipline);
+    # FHH_METRICS_PORT unset costs one getenv.
+    sid = _peek_server_id(sys.argv[1:])
+    obs.exporter.maybe_start(f"s{sid}" if sid in (0, 1) else "server")
     # arg validation runs BEFORE the exit-report contract: a server that
     # dies here has no identity yet, and writing the run report to the
     # bare shared $FHH_RUN_REPORT path would clobber the leader's
@@ -98,6 +125,9 @@ def main() -> None:
     # server re-reads its crawl programs instead of recompiling them —
     # recovery cost stays network + restore, not compile churn
     compile_cache.enable()
+    # fresh-compile telemetry (obs.devmem): every backend compile counts
+    # under the active phase; past the warmup ladder it is alert fodder
+    obs.devmem.install_compile_listener()
     # both servers + the leader inherit ONE $FHH_RUN_REPORT from the shared
     # environment; the leader keeps the bare path, each server claims a
     # .s<id> sibling so the last exiter can't clobber the others' reports

@@ -29,6 +29,22 @@ platform warning):
   ``FHH_TRACE_DIR``; ``python -m fuzzyheavyhitters_tpu.obs.trace merge``
   emits one clock-corrected Perfetto timeline.  ``FHH_PROFILE`` adds
   JAX profiler captures keyed to the same trace ids.
+- :mod:`.exporter` — the LIVE plane: a zero-dependency Prometheus
+  ``/metrics`` HTTP endpoint (``FHH_METRICS_PORT``; strictly zero-cost
+  unset) serving every live registry's counters/gauges/timers plus the
+  fixed-bucket histograms as ``_bucket`` series.
+- :mod:`.devmem` — device-memory + XLA-compile telemetry: HBM
+  in-use/watermark/delta gauges (live-arrays fallback on CPU),
+  per-session key-plane residency bytes, and fresh-compile counters
+  attributed to the active phase — a recompile past the warmup ladder
+  is a named, counted event.
+- :mod:`.alerts` — declarative threshold rules (tenant stall, SLO burn,
+  ingest backlog, recompile-after-warmup, HBM high water) fired once
+  per subject into the logs + trace ring, ``status.alerts``, and the
+  run report's ``alerts`` section.
+- :mod:`.ops` — ``python -m fuzzyheavyhitters_tpu.obs.ops top``: the
+  one-screen live view scraping all three processes' /metrics and
+  merging per-collection rows.
 
 Env knobs (all optional):
 
@@ -44,9 +60,16 @@ Env knobs (all optional):
   ``FHH_TRACE_RING`` bounds events per ring segment
 - ``FHH_PROFILE``: directory; wrap each crawl (or only the levels in
   ``FHH_PROFILE_LEVELS=2,5``) in a ``jax.profiler`` capture
+- ``FHH_METRICS_PORT``: base port; when set, each process serves
+  ``/metrics`` on base + its tag offset (leader +0, s0 +1, s1 +2);
+  ``0`` binds an ephemeral port (tests).  ``FHH_METRICS_HOST`` binds a
+  non-loopback interface.
+- ``FHH_ALERT_STALL_S`` / ``FHH_ALERT_LEVEL_P95_S`` /
+  ``FHH_ALERT_BACKLOG_KEYS`` / ``FHH_ALERT_HBM_FRAC``: alert-rule
+  thresholds (obs.alerts; defaults 120 / 2.0 / 100000 / 0.9)
 """
 
-from . import trace
+from . import alerts, devmem, exporter, trace
 from .heartbeat import start_heartbeat, stop_heartbeat
 from .hist import Histogram
 from .logs import configure as configure_logs, emit
@@ -63,8 +86,11 @@ from .report import (
 __all__ = [
     "Histogram",
     "Registry",
+    "alerts",
     "all_registries",
     "claim_report_path",
+    "devmem",
+    "exporter",
     "configure_logs",
     "default_registry",
     "emit",

@@ -78,6 +78,7 @@ import os
 import signal
 import time
 
+from . import alerts as _alerts
 from . import metrics
 from . import trace as tracemod
 from .hist import Histogram
@@ -146,6 +147,13 @@ def run_report(registries=None) -> dict:
     slo = _slo_summary(out)
     if slo is not None:
         doc["slo"] = slo
+    # alert transitions (obs.alerts): everything that fired in this
+    # process, so a postmortem reader sees the stall/burn/backlog
+    # events inline with the accounting they explain — None (absent)
+    # when nothing fired, keeping the pre-alert report shape exact
+    al = _alerts.report_section()
+    if al is not None:
+        doc["alerts"] = al
     if dropped:
         doc["dropped_registries"] = dropped
     return doc

@@ -69,6 +69,7 @@ _DEFAULT_RING = 200_000
 
 # (trace_id, current_span_id) for the running task; None = no trace
 _CTX: contextvars.ContextVar = contextvars.ContextVar(
+    # fhh-lint: disable=metric-naming (contextvar name, not a series)
     "fhh_trace_ctx", default=None
 )
 
@@ -495,6 +496,7 @@ def load_events(trace_dir: str) -> list:
     except OSError:
         return events
     for name in names:
+        # fhh-lint: disable=metric-naming (ring-file prefix, not a series)
         if not (name.startswith("fhh_trace_") and ".jsonl" in name):
             continue
         try:

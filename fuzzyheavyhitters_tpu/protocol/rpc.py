@@ -93,12 +93,16 @@ import pickle
 import secrets as _secrets
 import struct
 import time
+import weakref
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .. import obs
+from ..obs import alerts as obsalerts
+from ..obs import devmem as obsdevmem
+from ..obs import exporter as obsexporter
 from ..obs import metrics as obsmetrics
 from ..obs import trace as obstrace
 from ..ops import baseot, dpf, gc, otext
@@ -267,6 +271,48 @@ _SERVER_GUARDS = {
 }
 
 
+def _session_metrics_producer(ref):
+    """A /metrics producer bound to a CollectorServer by weakref: per
+    scrape, publish live session rows as labeled gauges and run the
+    session alert rules over the same snapshot.  Returns ``None`` once
+    the server is gone so the exporter prunes it."""
+
+    def produce():
+        srv = ref()
+        if srv is None:
+            return None
+        try:
+            sess = srv._sessions_status()
+        # fhh-lint: disable=broad-except (scrape-thread probe racing the
+        # event loop: a torn dict iteration skips one frame, never 500s)
+        except Exception:
+            return []
+        obsalerts.evaluate_sessions(
+            sess["per_session"], source=f"server{srv.server_id}"
+        )
+        reg = f'registry="server{srv.server_id}"'
+        lines = [
+            "# TYPE fhh_session_last_progress_seconds gauge",
+            "# TYPE fhh_session_queue_depth_keys gauge",
+            "# TYPE fhh_session_dedup_entries gauge",
+        ]
+        for key, row in sorted(sess["per_session"].items()):
+            lbl = f'{{{reg},collection="{obsexporter._esc(key)}"}}'
+            lines.append(
+                f"fhh_session_last_progress_seconds{lbl}"
+                f" {row['last_progress_s']}"
+            )
+            lines.append(
+                f"fhh_session_queue_depth_keys{lbl} {row['queue_depth']}"
+            )
+            lines.append(
+                f"fhh_session_dedup_entries{lbl} {row['dedup_entries']}"
+            )
+        return lines
+
+    return produce
+
+
 class CollectorServer:
     """One collector server process (ref: server.rs:44-172).
 
@@ -324,6 +370,14 @@ class CollectorServer:
         # serializes plane_reset); per-collection verbs serialize on
         # their session's OWN _verb_lock instead
         self._verb_lock = asyncio.Lock()
+        # live /metrics plane (obs.exporter): when the exporter is up,
+        # this server publishes its session rows per scrape and lets the
+        # alert engine evaluate them.  Weakref producer: a dropped server
+        # (tests construct hundreds) returns None and is pruned instead
+        # of pinning its registries forever.  Gated on running() so the
+        # disabled path registers nothing at all.
+        if obsexporter.running():
+            obsexporter.add_producer(_session_metrics_producer(weakref.ref(self)))
         # LAST: the sanitizer (a no-op unless FHH_DEBUG_GUARDS=1 or
         # cfg.debug_guards) wraps the already-constructed guarded state
         guards.install(self, _SERVER_GUARDS, force=self.cfg.debug_guards)
@@ -1533,6 +1587,18 @@ class CollectorServer:
         queue depth, replay-dedup entries, and checkpoint levels, plus
         the tenant scheduler's stall-fill accounting."""
         cs = cs if cs is not None else self._default()
+        # live device-memory sample (obs.devmem): the status probe IS
+        # the operator's HBM tick — watermark/delta land on this
+        # server's registry, scrape-visible and report-visible
+        obsdevmem.sample(self.obs, phase="status")
+        sess = self._sessions_status()
+        # alert tick: session rules over the rows just built, registry
+        # rules over everything live — fire-once per (rule, subject), so
+        # repeated probes of a stalled tenant yield ONE alert event
+        obsalerts.evaluate_sessions(
+            sess["per_session"], source=f"server{self.server_id}"
+        )
+        obsalerts.evaluate_registries()
         return {
             "boot_id": self._boot_id,
             "collection": cs.key,
@@ -1556,11 +1622,15 @@ class CollectorServer:
             # reduction/recovery instruments the run report rolls up
             "mesh": self._mesh_status(cs),
             # multi-tenant rollup (sessions.SessionTable + tenancy)
-            "sessions": self._sessions_status(),
+            "sessions": sess,
             # live SLO quantiles (obs.hist): per-level crawl latency,
             # per-verb RPC latency, seal-to-hitters — p50/p95/p99 from
             # the calling session's fixed-bucket histograms
             "slo": cs.obs.hists_summary(),
+            # alert transitions (obs.alerts): every rule that fired in
+            # this process, newest last — the supervisor's "is anything
+            # wrong" answer without scraping /metrics
+            "alerts": obsalerts.status_section(),
         }
 
     def _sessions_status(self) -> dict:  # fhh-race: atomic (read-only rollup over the session table in one event-loop slice; per-session reads are point-in-time probes for an operator, not protocol state)
@@ -2058,6 +2128,12 @@ class CollectorServer:
         cs.obs.count("warmup_shapes", shapes)
         if ladder_hits:
             cs.obs.count("warmup_ladder_hits", ladder_hits)
+        # the warmup ladder is now the compile baseline: every fresh XLA
+        # compile from here on is a NAMED, counted anomaly
+        # (devmem.fresh_compiles_post_warmup -> recompile_after_warmup
+        # alert), and the post-warmup HBM watermark is the crawl's floor
+        obsdevmem.note_warmup_done()
+        obsdevmem.sample(cs.obs, phase="warmup")
         return {"shapes": shapes, "ladder_hits": ladder_hits}
 
     def _warm_key(self, cs: CollectionSession, fb: int, L: int,

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import obs as obsmod
+from ..obs import devmem
 from ..obs import metrics as obsmetrics
 from ..ops import dpf, prg
 from ..ops.fields import F255, FE62
@@ -485,6 +486,12 @@ class CollectionSession:
         if self._mesh is not None:
             self._mesh.bind(self.keys.cw_seed.shape[0])
             self.keys = self._mesh.shard_keys(self.keys)
+        # key-plane residency (obs.devmem): the flagship's "1.51 chips
+        # of key storage" risk as a live per-collection gauge — set at
+        # the one place the materialized plane changes size
+        self.obs.gauge(
+            "key_plane_bytes", devmem.tree_nbytes(tuple(self.keys))
+        )
 
     def concat_sketch(self) -> None:  # fhh-race: holds=_verb_lock (reached only from tree_init/tree_restore under this session's verb lock; sanitizer-validated)
         """Materialize ``self._sketch`` from the uploaded chunks."""

@@ -15,11 +15,25 @@
 #     null; BENCH_r05 timed out with no line at all; both fail here)
 #   - also asserts the line stays under the harness's ~2000-byte stdout
 #     tail capture
+#   - runs with the live /metrics plane ON (FHH_METRICS_PORT): a sidecar
+#     scraper polls the bench children mid-run and the UNION of series
+#     it sees must cover the ops tentpole (level-latency buckets from a
+#     server registry, the sharded-sketch gauge, live session rows) — a
+#     bench that goes dark on the wire fails even if its numbers land
+#   - FAILS if the final --out artifact is still marked "partial": true
+#     (the crash-proof manifest must CLOSE on a clean run)
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 budget="${1:-600}"
 out="$(mktemp)"
+
+# live telemetry plane for the whole run: every bench child claims the
+# base port ("bench" tag, +0) while it holds the serial leg slot
+metrics_port="${FHH_METRICS_PORT:-29817}"
+export FHH_METRICS_PORT="$metrics_port"
+artifact="$(mktemp -u).bench.json"
+union="$(mktemp)"
 
 # distributed tracing on for the whole smoke run: every bench child
 # process appends to its own ring under $trace_dir, and the merged
@@ -36,12 +50,97 @@ fi
 
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" FHH_BENCH_SMOKE=1 \
     FHH_BENCH_BUDGET="$budget" \
-    timeout -k 10 "$((budget + 60))" python bench.py > "$out" 2> "$out.err"
+    timeout -k 10 "$((budget + 60))" python bench.py --out "$artifact" \
+    > "$out" 2> "$out.err" &
+bench_pid=$!
+
+# mid-run scraper: accumulate the union of every fhh_ series (and its
+# registry label) the live exporter shows while the bench runs — gaps
+# between serial children just read as refused connections
+python - "$bench_pid" "$metrics_port" "$union" <<'EOF'
+import os, sys, time, urllib.request
+
+pid, port, union_path = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+seen = set()
+def alive(p):
+    try:
+        os.kill(p, 0)
+        return True
+    except OSError:
+        return False
+while alive(pid):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=1
+        ) as resp:
+            text = resp.read().decode("utf-8", "replace")
+        for line in text.splitlines():
+            if not line.startswith("fhh_"):
+                continue
+            name = line.split("{", 1)[0].split(" ", 1)[0]
+            seen.add(name)
+            if 'registry="' in line:
+                reg = line.split('registry="', 1)[1].split('"', 1)[0]
+                seen.add(f"{name}@{reg}")
+    except Exception:
+        # a child exiting mid-response raises IncompleteRead (not
+        # OSError); any scrape failure is just a gap, never fatal
+        pass
+    # persist incrementally: a scraper crash must not zero the union
+    with open(union_path, "w") as f:
+        f.write("\n".join(sorted(seen)))
+    time.sleep(1.0)
+EOF
+
+wait "$bench_pid"
 rc=$?
 if [ $rc -ne 0 ]; then
     echo "bench_smoke: bench.py exited rc=$rc" >&2
     tail -5 "$out.err" >&2
-    rm -f "$out" "$out.err"
+    rm -f "$out" "$out.err" "$union" "$artifact"
+    rm -rf "$trace_dir"
+    exit 1
+fi
+
+# the live plane carried the tentpole series: per-level SLO buckets off
+# a server registry, the sharded malicious-verify gauge, session rows
+if ! python - "$union" <<'EOF'
+import sys
+
+seen = set(open(sys.argv[1]).read().splitlines())
+required = [
+    "fhh_level_latency_seconds_bucket@server0",
+    "fhh_sketch_shards",
+    "fhh_session_last_progress_seconds",
+]
+missing = [r for r in required if r not in seen]
+assert not missing, (
+    f"required /metrics series never seen mid-run: {missing} "
+    f"(union carried {len(seen)} series)"
+)
+print(f"bench_smoke metrics OK: union of {len(seen)} live series")
+EOF
+then
+    echo "bench_smoke: live /metrics union gate FAILED" >&2
+    rm -f "$out" "$out.err" "$union" "$artifact"
+    rm -rf "$trace_dir"
+    exit 1
+fi
+
+# the crash-proof manifest must CLOSE on a clean run
+if ! python - "$artifact" <<'EOF'
+import json, sys
+
+doc = json.load(open(sys.argv[1]))
+assert not doc.get("partial"), (
+    "bench exited rc=0 but its artifact is still partial "
+    f"(reason={doc.get('reason')!r}, legs={sorted(doc.get('results', {}))})"
+)
+print("bench_smoke artifact OK: manifest closed")
+EOF
+then
+    echo "bench_smoke: final artifact still marked partial" >&2
+    rm -f "$out" "$out.err" "$union" "$artifact"
     rm -rf "$trace_dir"
     exit 1
 fi
@@ -53,7 +152,7 @@ if ! python -m fuzzyheavyhitters_tpu.obs.trace merge \
 then
     echo "bench_smoke: merged fhh-trace FAILED validation" >&2
     tail -20 "$trace_dir/verdict.json" >&2
-    rm -f "$out" "$out.err"; rm -rf "$trace_dir"
+    rm -f "$out" "$out.err" "$union" "$artifact"; rm -rf "$trace_dir"
     exit 1
 fi
 if ! python - "$trace_dir/verdict.json" <<'EOF'
@@ -69,7 +168,7 @@ print(
 EOF
 then
     echo "bench_smoke: trace verdict assertions FAILED" >&2
-    rm -f "$out" "$out.err"; rm -rf "$trace_dir"
+    rm -f "$out" "$out.err" "$union" "$artifact"; rm -rf "$trace_dir"
     exit 1
 fi
 
@@ -180,6 +279,6 @@ print(
 )
 EOF
 rc=$?
-rm -f "$out" "$out.err"
+rm -f "$out" "$out.err" "$union" "$artifact"
 rm -rf "$trace_dir"
 exit $rc

@@ -89,6 +89,72 @@ def pytest_runtest_call(item):
     )
 
 
+def _listening_inodes():
+    """Socket inodes this process holds that are in LISTEN state, via
+    /proc (None where /proc is unavailable — the guard degrades to a
+    no-op off Linux).  Two joins: /proc/self/fd names our socket
+    inodes, /proc/net/tcp{,6} names the machine's listeners (state 0A);
+    the intersection is exactly 'sockets WE are listening on'."""
+    try:
+        fds = os.listdir("/proc/self/fd")
+    except OSError:
+        return None
+    ours = set()
+    for fd in fds:
+        try:
+            tgt = os.readlink(os.path.join("/proc/self/fd", fd))
+        except OSError:
+            continue  # fd closed between listdir and readlink
+        if tgt.startswith("socket:["):
+            ours.add(tgt[len("socket:["):-1])
+    listening = set()
+    seen_table = False
+    for table in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            with open(table) as f:
+                lines = f.readlines()[1:]
+        except OSError:
+            continue
+        seen_table = True
+        for line in lines:
+            parts = line.split()
+            if len(parts) > 9 and parts[3] == "0A":  # TCP_LISTEN
+                listening.add(parts[9])
+    if not seen_table:
+        return None
+    return ours & listening
+
+
+@pytest.fixture(autouse=True)
+def no_leaked_listeners():
+    """Every test must close the listening sockets it opens — the
+    regression guard for the EADDRINUSE class where a leaked server
+    socket poisons a later test's bind of the same port.  First in the
+    fixture stack (conftest autouse), so per-test server fixtures tear
+    down BEFORE the post-check; a leak surviving gc.collect() fails the
+    leaking test itself, not the innocent victim that binds next."""
+    before = _listening_inodes()
+    yield
+    if before is None:
+        return
+    after = _listening_inodes()
+    if after is None:
+        return
+    leaked = after - before
+    if leaked:
+        import gc
+
+        gc.collect()  # drop listeners kept alive only by cycles
+        after = _listening_inodes()
+        leaked = (after or set()) - before
+    assert not leaked, (
+        f"test leaked {len(leaked)} listening socket(s) "
+        f"(/proc/net inode(s) {sorted(leaked)}) — close servers in the "
+        "test (exporter tests: obs.exporter.stop(); asyncio servers: "
+        "srv.close() + wait_closed())"
+    )
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)

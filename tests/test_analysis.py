@@ -568,6 +568,66 @@ def test_unbounded_queue_scoped_and_suppressible():
 
 
 # ---------------------------------------------------------------------------
+# rule: metric-naming
+# ---------------------------------------------------------------------------
+
+
+def test_metric_naming_bad_registry_names_detected():
+    src = """
+    def f(reg, n):
+        reg.count("Fresh-Compiles", n)
+        reg.gauge("queue.depth", n)
+        reg.observe("levelLatency", 0.5)
+    """
+    fs = _lint(src, rule="metric-naming")
+    assert _names(fs) == ["metric-naming"] * 3
+    assert [f.line for f in fs] == [3, 4, 5]
+
+
+def test_metric_naming_valid_and_nonname_literals_clean():
+    src = """
+    def f(reg, log, n):
+        reg.count("fresh_compiles", n)
+        reg.count("fresh_compiles:rt_keygen", n)
+        reg.observe("level_latency", 0.5)
+        reg.timer_add("xla_compile", 0.5)
+        log.count("alert fired {rule}")  # spaces/braces: str.count search
+        return "some. punctuation!"  # not even identifier-like
+    """
+    assert _lint(src, rule="metric-naming") == []
+
+
+def test_metric_naming_exported_literal_needs_unit_suffix():
+    src = """
+    GOOD = ("fhh_data_bytes_sent_total", "fhh_session_queue_depth_keys")
+    BAD = "fhh_alert"
+    """
+    fs = _lint(src, rule="metric-naming")
+    assert _names(fs) == ["metric-naming"]
+    assert fs[0].line == 3
+    # f-string fragments are assembly, never whole series names
+    frag = """
+    def render(name):
+        return f"fhh_{name}_total 1"
+    """
+    assert _lint(frag, rule="metric-naming") == []
+
+
+def test_metric_naming_scoped_to_metric_modules():
+    src = """
+    def f(reg, n):
+        reg.count("Fresh-Compiles", n)
+    """
+    # tests/ ARE in scope (they hand-roll scrape keys); workloads are not
+    assert len(_lint(src, "tests/test_x.py", rule="metric-naming")) == 1
+    assert _lint(
+        src,
+        "fuzzyheavyhitters_tpu/workloads/fake.py",
+        rule="metric-naming",
+    ) == []
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -779,8 +839,9 @@ def test_pyproject_and_dataclass_defaults_do_not_drift():
         "hot_modules", "hot_roots", "secret_lexicon", "sink_calls",
         "print_scope", "print_allowed", "shared_state_modules",
         "await_modules", "readback_modules", "queue_modules",
-        "span_modules", "race_modules", "guards", "default_paths",
-        "baseline",
+        "span_modules", "metric_modules", "metric_calls",
+        "metric_unit_suffixes", "race_modules", "guards",
+        "default_paths", "baseline",
     ):
         assert getattr(operative, key) == getattr(defaults, key), key
 
@@ -922,6 +983,7 @@ def test_every_rule_has_fixture_coverage():
         "unbounded-await",
         "unbounded-queue",
         "span-discipline",
+        "metric-naming",
         # fixtures in tests/test_concurrency.py
         "guarded-state-unlocked",
         "stale-read-across-await",
