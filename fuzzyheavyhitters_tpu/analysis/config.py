@@ -63,6 +63,42 @@ _DEFAULT_GUARDS = {
 }
 
 
+# the fhh-taint source table shipped for THIS repo (mirrored by
+# pyproject [tool.fhh-lint.taint] and the runtime twin
+# utils/taint_guard._DEFAULT_SOURCES — both drift-tested): dotted keys
+# bind attribute READS (matched by attr name, receiver-agnostic — the
+# names are chosen distinctive for exactly that reason); dotless keys
+# bind function-call RETURNS (matched by last segment, so
+# ``secure.derive_seed(...)`` matches cross-module).  Values document
+# the key material; the analyzer reports the key as the source label.
+_DEFAULT_TAINT = {
+    # per-session secrets (protocol/sessions.py; read through the
+    # rpc/mesh delegation properties under the same attr names)
+    "CollectionSession._sec_seed": "per-session GC/b2a PRG root seed",
+    "CollectionSession._sketch_seed": "sketch challenge coin (server-server secret)",
+    "CollectionSession._ratchet_digest": "crawl transcript ratchet digest",
+    "CollectionSession._last_shares": "expanded field share planes",
+    # ibDCF/DPF key material (protocol/sketch.py)
+    "SketchKeyBatch.root_seed": "sketch DPF root seeds",
+    # IKNP OT-extension endpoint state (ops/otext.py)
+    "OtExtSender.s_bits": "OT sender choice bits (= free-XOR offset R)",
+    "OtExtSender.s_block": "packed OT sender choice block",
+    "OtExtSender._s_dev": "device copy of the OT sender choice bits",
+    "OtExtSender._seeds": "base-OT seeds selected by s",
+    "OtExtReceiver._seeds0": "base-OT seed column 0",
+    "OtExtReceiver._seeds1": "base-OT seed column 1",
+    # function-return sources
+    "derive_seed": "per-(purpose, level, ctr) PRG seed off the session seed",
+    "mask_seed": "key_short-masked PRG seed (still key material)",
+    "ratchet_seed": "per-level sketch challenge seed",
+    "transcript_init": "transcript ratchet digest root",
+    "transcript_absorb": "advanced transcript ratchet digest",
+    "gen_triples": "Beaver triple shares",
+    "_seed_from_point": "base-OT seed H(index, point)",
+    "seeds": "base-OT seed columns (ops/baseot.py endpoints)",
+}
+
+
 @dataclass
 class LintConfig:
     # host-sync rule: path prefixes whose loop bodies are hot, and
@@ -212,6 +248,53 @@ class LintConfig:
     guards: dict = field(
         default_factory=lambda: dict(_DEFAULT_GUARDS)
     )
+    # fhh-taint rules (analysis/taint.py): modules whose secret flows
+    # are analyzed interprocedurally — the protocol planes where the
+    # sources live, and the obs plane where the sinks live
+    taint_modules: tuple = (
+        "fuzzyheavyhitters_tpu/protocol",
+        "fuzzyheavyhitters_tpu/ops",
+        "fuzzyheavyhitters_tpu/parallel",
+        "fuzzyheavyhitters_tpu/obs",
+    )
+    # fhh-taint source table: "Class.attr" -> attribute-read sources,
+    # "fn" -> call-return sources.  Operative copy: pyproject
+    # [tool.fhh-lint.taint]; runtime twin: utils/taint_guard.
+    taint: dict = field(
+        default_factory=lambda: dict(_DEFAULT_TAINT)
+    )
+    # sink boundaries: the obs emit/trace/alert/metric call names taint
+    # must never reach (exception messages are always sinks)
+    taint_sinks: tuple = (
+        "emit",
+        "print",
+        "instant",
+        "span",
+        "call_event",
+        "fire",
+        "_fire",
+        "count",
+        "gauge",
+        "observe",
+        "timer_add",
+    )
+    # wire boundaries for unmasked-wire: the frame-send entry points
+    taint_wire_calls: tuple = ("_send", "_dp_send")
+    # declared declassifiers: masking/opening operations whose output
+    # is public by protocol argument — pad-XOR encryptions, share
+    # openings, one-way commitments.  `declassified(reason)` contracts
+    # must name one of these (and the analyzer checks it is called).
+    taint_declassifiers: tuple = (
+        "ot2s_encrypt",
+        "ot2s_encrypt_packed",
+        "ot4_encrypt",
+        "b2a_encrypt",
+        "ev_open_ot4",
+        "ev_open_level",
+        "ev_open_fused",
+        "window_root",
+        "np_add",
+    )
     severity_overrides: dict = field(default_factory=dict)
     baseline: str = "lint_baseline.json"
     default_paths: tuple = ("fuzzyheavyhitters_tpu", "tests")
@@ -338,6 +421,10 @@ def load_config(root: str | None = None, pyproject: str | None = None) -> LintCo
         "metric_calls",
         "metric_unit_suffixes",
         "race_modules",
+        "taint_modules",
+        "taint_sinks",
+        "taint_wire_calls",
+        "taint_declassifiers",
         "default_paths",
     ):
         val = section.get(key)
@@ -352,6 +439,15 @@ def load_config(root: str | None = None, pyproject: str | None = None) -> LintCo
         cfg.guards = {
             k: v
             for k, v in guards.items()
+            if isinstance(k, str) and isinstance(v, str)
+        }
+    taint = section.get("taint")
+    if isinstance(taint, dict):
+        # same table-replaces-default semantics as guards: retiring a
+        # source declaration must be possible from pyproject alone
+        cfg.taint = {
+            k: v
+            for k, v in taint.items()
             if isinstance(k, str) and isinstance(v, str)
         }
     sev = section.get("severity")

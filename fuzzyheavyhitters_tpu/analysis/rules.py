@@ -92,6 +92,7 @@ import ast
 import re
 
 from .concurrency import RACE_RULES
+from .taint import TAINT_RULES
 from .engine import Rule, SourceModule, dotted_name, last_segment
 
 # ---------------------------------------------------------------------------
@@ -1058,6 +1059,10 @@ ALL_RULES: tuple[Rule, ...] = (
     MetricNaming(),
     # the interprocedural fhh-race pair (analysis/concurrency.py)
     *RACE_RULES,
+    # the interprocedural fhh-taint triple (analysis/taint.py) — in
+    # taint_modules these supersede lexical secret-to-sink, which stays
+    # on everywhere as the fast pre-filter (subset-tested)
+    *TAINT_RULES,
 )
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -49,6 +49,7 @@ import time
 
 from . import logs
 from . import trace as _trace
+from ..utils import taint_guard
 from .hist import Histogram
 from .metrics import all_registries
 
@@ -80,6 +81,9 @@ def _threshold(knob: tuple[str, float]) -> float:
 
 def _fire(rule: str, subject: str, **ctx) -> None:
     global _dropped
+    # alert subjects/context cross to logs + trace + the /metrics
+    # exposition: assert no registered secret buffer rides along
+    taint_guard.check((subject, ctx), sink="alert-fire")
     with _lock:
         if (rule, subject) in _seen:
             return

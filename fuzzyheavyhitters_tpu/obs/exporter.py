@@ -43,6 +43,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from . import logs
+from ..utils import taint_guard
 from .hist import BUCKET_BOUNDS
 from .metrics import all_registries
 
@@ -212,7 +213,11 @@ def render() -> str:
             for prod in dead:
                 if prod in _producers:
                     _producers.remove(prod)
-    return "\n".join(lines) + "\n"
+    text = "\n".join(lines) + "\n"
+    # the full exposition document is what an outward-facing scrape
+    # sees: the shadow-taint sanitizer's most important boundary
+    taint_guard.check(text, sink="metrics-render")
+    return text
 
 
 def add_producer(fn) -> None:

@@ -80,6 +80,7 @@ import time
 
 from . import alerts as _alerts
 from . import metrics
+from ..utils import taint_guard
 from . import trace as tracemod
 from .hist import Histogram
 
@@ -156,6 +157,9 @@ def run_report(registries=None) -> dict:
         doc["alerts"] = al
     if dropped:
         doc["dropped_registries"] = dropped
+    # the report is handed to files/stdout whole: assert no registered
+    # secret buffer rode a summary row in (fhh-taint runtime twin)
+    taint_guard.check(doc, sink="run-report")
     return doc
 
 

@@ -60,6 +60,8 @@ import sys
 import threading
 import time
 
+from ..utils import taint_guard
+
 ENV_DIR = "FHH_TRACE_DIR"
 ENV_RING = "FHH_TRACE_RING"
 ENV_PROFILE = "FHH_PROFILE"
@@ -203,6 +205,9 @@ def _writer() -> "_Writer | None":
 
 
 def _event(rec: dict) -> None:
+    # every span/instant/call record funnels through here: the one
+    # place the shadow-taint sanitizer can watch the whole trace plane
+    taint_guard.check(rec, sink="trace-event")
     w = _writer()
     if w is not None:
         w.write(rec)

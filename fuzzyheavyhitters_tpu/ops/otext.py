@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import taint_guard
 from . import baseot, prg
 
 KAPPA = 128  # security parameter: base-OT count == row width in bits
@@ -307,6 +308,8 @@ class OtExtSender:
             # secret-to-sink)
             got_shape = tuple(int(x) for x in seeds.shape)
             raise ValueError(f"need uint32[128, 4] base seeds, got {got_shape}")
+        taint_guard.register("OtExtSender.s_bits", s_bits)
+        taint_guard.register("OtExtSender._seeds", np.asarray(seeds))
         self.s_bits = s_bits
         self.s_block = s_to_block(s_bits)  # uint32[4]
         self._seeds = jnp.asarray(seeds, jnp.uint32)
@@ -376,6 +379,8 @@ class OtExtReceiver:
     def __init__(self, seeds0: np.ndarray, seeds1: np.ndarray):
         if seeds0.shape != (KAPPA, 4) or seeds1.shape != (KAPPA, 4):
             raise ValueError("need two uint32[128, 4] base-seed columns")
+        taint_guard.register("OtExtReceiver._seeds0", np.asarray(seeds0))
+        taint_guard.register("OtExtReceiver._seeds1", np.asarray(seeds1))
         self._seeds0 = jnp.asarray(seeds0, jnp.uint32)
         self._seeds1 = jnp.asarray(seeds1, jnp.uint32)
         self._off = 0

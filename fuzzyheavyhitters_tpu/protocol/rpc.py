@@ -111,7 +111,7 @@ from ..ops.ibdcf import EvalState, IbDcfKeyBatch
 from ..parallel import kernel_shard, server_mesh as smesh, sketch_shard
 from ..resilience import chaos as reschaos
 from ..resilience import policy as respolicy
-from ..utils import guards
+from ..utils import guards, taint_guard
 from ..utils.config import Config
 from . import collect, mpc, secure, sessions, sketch as sketchmod, tenancy
 from .sessions import (  # noqa: F401  (re-exports: wire-format helpers kept importable as rpc.*)
@@ -2780,6 +2780,9 @@ class CollectorServer:
             cs._sketch_seed = np.frombuffer(
                 bytes(a ^ b for a, b in zip(mine, theirs)), dtype="<u4"
             ).copy()
+            taint_guard.register(
+                "CollectionSession._sketch_seed", cs._sketch_seed
+            )
             await self._setup_secure(cs)
         cs.plane_epoch = self._plane.epoch
         obs.emit(
@@ -2823,6 +2826,7 @@ class CollectorServer:
         cs._sec_seed = np.frombuffer(
             _secrets.token_bytes(16), dtype="<u4"
         ).copy()
+        taint_guard.register("CollectionSession._sec_seed", cs._sec_seed)
 
 
 class ServerRestartedError(ConnectionError):

@@ -54,7 +54,7 @@ from ..ops.fields import F255, FE62
 from ..ops.ibdcf import IbDcfKeyBatch
 from ..parallel import server_mesh as smesh
 from ..resilience import admission as resadmission
-from ..utils import guards
+from ..utils import guards, taint_guard
 from ..utils.config import Config
 from . import collect, mpc, sketch as sketchmod
 
@@ -424,6 +424,9 @@ class CollectionSession:
             self._sec_seed = np.frombuffer(
                 _secrets.token_bytes(16), dtype="<u4"
             ).copy()
+            taint_guard.register(
+                "CollectionSession._sec_seed", self._sec_seed
+            )
 
     def clear_crawl_state(self) -> None:  # fhh-race: holds=_verb_lock (reached only from window_load/tree_restore, which run under this session's verb lock; sanitizer-validated)
         """Drop the crawl-plane state while leaving ingest pools and

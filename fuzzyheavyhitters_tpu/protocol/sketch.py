@@ -71,6 +71,7 @@ import numpy as np
 
 from ..ops import dpf, prg
 from ..ops.dpf import DpfKeyBatch
+from ..utils import taint_guard
 from . import mpc
 
 LANES = 2  # payload lanes: (x, k·x)
@@ -124,7 +125,12 @@ def transcript_absorb(
     h.update(np.ascontiguousarray(
         np.asarray(pat_bits[:n_alive], bool)
     ).tobytes())
-    return h.digest()
+    out = h.digest()
+    # the advanced digest commits to the survivor tables — private data;
+    # the runtime taint sanitizer watches its bytes at every obs sink
+    # (transcript_init's root is a public tag hash and is NOT registered)
+    taint_guard.register("CollectionSession._ratchet_digest", out)
+    return out
 
 
 def ratchet_seed(root_seed, level: int, digest: bytes) -> np.ndarray:

@@ -1,0 +1,240 @@
+"""Runtime shadow-taint sanitizer: the dynamic half of fhh-taint.
+
+The static analyzer (:mod:`fuzzyheavyhitters_tpu.analysis.taint`)
+proves the declared source table cannot reach an obs sink *through the
+flows it can see* — but its call resolution is name-based and
+per-module, and an unresolved cross-module hop drops taint by design
+(the conservative-for-noise direction).  This module closes the gap
+dynamically, sanitizer-style: under ``FHH_DEBUG_TAINT=1`` the source
+constructors :func:`register` their secret buffers' bytes, and every
+obs sink boundary (log emit, metrics render, trace record, alert fire,
+report build) runs :func:`check` over the rendered payload, asserting
+no registered buffer — byte-equal, byte-contained, or interpolated as
+its repr/hex text — crosses.  The existing tier-1 e2e + chaos suites
+then exercise the assertion on every scrape, trace, and recovery path
+they already cover.
+
+Off by default, zero overhead when off: with the env var unset,
+:func:`register` returns before touching the payload and :func:`check`
+is one module-global bool test — the obs hot paths pay a function call,
+never a hash or a scan.
+
+Sanctioned flows run inside :func:`declassified`, whose required
+``reason`` is the runtime twin of the written
+``# fhh-taint: declassified(reason)`` contract at the same static
+site (grep the reason text to find its twin).
+
+:data:`_DEFAULT_SOURCES` names the registration sites this repo arms —
+one entry per source the pyproject ``[tool.fhh-lint.taint]`` table
+declares for a RUNTIME-REGISTRABLE buffer (drift-tested in
+tests/test_taint.py).  The window/sketch roots are deliberately absent:
+they are one-way commitments CARRIED by seal stats and checkpoints by
+design (sketch.window_root), and registering them would assert against
+the protocol itself.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+
+__all__ = [
+    "TaintViolation",
+    "check",
+    "declassified",
+    "enabled",
+    "register",
+    "reset",
+]
+
+_ENV = "FHH_DEBUG_TAINT"
+
+# registration sites armed in THIS repo: source label (matching the
+# pyproject [tool.fhh-lint.taint] key) -> the constructor that calls
+# register().  Drift-tested against pyproject so a new declared source
+# cannot ship without deciding whether the runtime twin registers it.
+_DEFAULT_SOURCES = {
+    "CollectionSession._sec_seed": "protocol/sessions.py + rpc._setup_secure",
+    "CollectionSession._sketch_seed": "rpc plane_handshake coin flip",
+    "CollectionSession._ratchet_digest": "sketch.transcript_init/_absorb",
+    "OtExtSender._seeds": "ops/otext.py OtExtSender.__init__",
+    "OtExtSender.s_bits": "ops/otext.py OtExtSender.__init__",
+    "OtExtReceiver._seeds0": "ops/otext.py OtExtReceiver.__init__",
+    "OtExtReceiver._seeds1": "ops/otext.py OtExtReceiver.__init__",
+}
+
+# True once ANY source registered in this process: the sink-boundary
+# check() calls reduce to one global bool test until then
+_armed = False
+
+# registered markers: byte images of source buffers, and the string
+# forms an f-string interpolation would render them as
+_byte_markers: dict[bytes, str] = {}
+_text_markers: dict[str, str] = {}
+
+# depth of sanctioned declassification windows for the current task
+# (contextvars: one task's window never blesses a neighbour's render)
+_declass_depth: contextvars.ContextVar[int] = contextvars.ContextVar(
+    "fhh_declass_depth", default=0
+)
+
+# ignore trivially-short text markers: a 2-char scalar repr would trip
+# on unrelated digits in any rendered line
+_MIN_TEXT_MARKER = 8
+
+
+class TaintViolation(AssertionError):
+    """A registered secret buffer (or its rendered text) crossed an obs
+    sink boundary.  Subclasses AssertionError so test suites that treat
+    assertion failures as hard failures catch it without new plumbing."""
+
+
+def enabled() -> bool:
+    """True when the sanitizer is switched on (``FHH_DEBUG_TAINT=1``).
+    Read per registration — source construction is rare (session
+    handshake, OT setup), so the getenv never sits on a hot path."""
+    return os.environ.get(_ENV, "") == "1"
+
+
+_NOOP = contextlib.nullcontext()
+
+
+class _DeclassifiedWindow:
+    __slots__ = ("_token",)
+
+    def __enter__(self):
+        self._token = _declass_depth.set(_declass_depth.get() + 1)
+        return None
+
+    def __exit__(self, *exc):
+        _declass_depth.reset(self._token)
+        return False
+
+
+def declassified(reason: str):
+    """Suspend taint assertions for the current task while the body
+    runs — the runtime form of a written ``declassified(reason)``
+    contract.  ``reason`` is mandatory and non-empty."""
+    if not reason or not reason.strip():
+        raise ValueError("declassified() requires a written reason")
+    if not _armed:
+        return _NOOP
+    return _DeclassifiedWindow()
+
+
+def _buffer_bytes(value) -> bytes | None:
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return bytes(value)
+    tobytes = getattr(value, "tobytes", None)
+    if callable(tobytes):
+        try:
+            return tobytes()
+        # fhh-lint: disable=broad-except (a sanitizer must never crash
+        # the protocol it watches: an exotic tobytes() is just skipped)
+        except Exception:
+            return None
+    return None
+
+
+def register(label: str, value) -> None:
+    """Register one secret buffer under the sanitizer.  ``value`` is a
+    host ndarray or bytes-like (callers pass host-side state — the
+    source constructors all build np arrays or digests).  No-op (and
+    free) when the sanitizer is disabled; never raises on an exotic
+    value — a sanitizer must not crash the protocol it watches."""
+    global _armed
+    if not enabled() or value is None:
+        return
+    raw = _buffer_bytes(value)
+    if raw is None or len(raw) == 0:
+        return
+    _armed = True
+    _byte_markers[raw] = label
+    _text_markers.setdefault(raw.hex(), label)
+    try:  # the f-string interpolation form: str(np.ndarray) / str(bytes)
+        text = str(value).strip()
+    # fhh-lint: disable=broad-except (sanitizer-never-crashes: a value
+    # whose __str__ throws simply gets no text marker)
+    except Exception:
+        return
+    if len(text) >= _MIN_TEXT_MARKER:
+        _text_markers.setdefault(text, label)
+
+
+def reset() -> None:
+    """Drop all registered markers and disarm (test isolation)."""
+    global _armed
+    _armed = False
+    _byte_markers.clear()
+    _text_markers.clear()
+
+
+def _scan_text(text: str, sink: str) -> None:
+    for marker, label in _text_markers.items():
+        if marker in text:
+            # fhh-lint: disable=secret-to-sink (`label` here is the
+            # SOURCE NAME from the registry — "CollectionSession
+            # ._sec_seed" — never the secret bytes themselves)
+            raise TaintViolation(
+                f"rendered text at sink '{sink}' contains the "
+                f"interpolated bytes of registered source '{label}' — "
+                "key material crossed an obs boundary (fhh-taint "
+                "contract violation; mask/open it first or wrap a "
+                "sanctioned flow in taint_guard.declassified(reason))"
+            )
+
+
+def _scan_bytes(raw: bytes, sink: str) -> None:
+    for marker, label in _byte_markers.items():
+        if marker == raw or (len(raw) > len(marker) and marker in raw):
+            # fhh-lint: disable=secret-to-sink (`label` is the source
+            # NAME from the registry, never the secret bytes)
+            raise TaintViolation(
+                f"payload at sink '{sink}' carries the raw bytes of "
+                f"registered source '{label}' — key material crossed "
+                "an obs boundary (fhh-taint contract violation)"
+            )
+
+
+def _scan(obj, sink: str, depth: int) -> None:
+    if depth > 6 or obj is None or isinstance(obj, (bool, int, float)):
+        return
+    if isinstance(obj, str):
+        _scan_text(obj, sink)
+        return
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        _scan_bytes(bytes(obj), sink)
+        return
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _scan(k, sink, depth + 1)
+            _scan(v, sink, depth + 1)
+        return
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        for v in obj:
+            _scan(v, sink, depth + 1)
+        return
+    raw = _buffer_bytes(obj)
+    if raw is not None:
+        _scan_bytes(raw, sink)
+        return
+    # anything else renders via str() at the sink eventually; compare
+    # the rendered form (bounded: reprs of obs payloads are small)
+    try:
+        _scan_text(str(obj), sink)
+    except TaintViolation:
+        raise
+    # fhh-lint: disable=broad-except (sanitizer-never-crashes: objects
+    # whose __str__ throws are not scannable and not sink-renderable)
+    except Exception:
+        pass
+
+
+def check(obj, *, sink: str) -> None:
+    """Assert no registered source buffer is reachable from ``obj`` —
+    byte-equal, byte-contained, or rendered as text.  Called at every
+    obs sink boundary; one bool test when the sanitizer is off."""
+    if not _armed or _declass_depth.get():
+        return
+    _scan(obj, sink, 0)

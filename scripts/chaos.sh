@@ -55,6 +55,22 @@ if [ $guards_rc -ne 0 ]; then
     rc=1
 fi
 
+# fhh-taint runtime sanitizer stage: the same trusted + secure e2e
+# recovery legs with FHH_DEBUG_TAINT=1, so the session/OT secret
+# buffers register at their constructors and every obs sink boundary
+# (log emit, metrics render, trace record, alert fire, report build)
+# asserts no registered byte image crosses — the dynamic validation of
+# the static secret-flow pass under real chaos (utils/taint_guard.py)
+JAX_PLATFORMS=cpu FHH_DEBUG_TAINT=1 python -m pytest \
+    "tests/test_resilience.py::test_e2e_chaos_recovery_bit_identical" \
+    "tests/test_sessions.py::test_tenant_isolation_flood_and_kill_restart_mid_crawl" \
+    -q -p no:cacheprovider
+taint_rc=$?
+if [ $taint_rc -ne 0 ]; then
+    echo "chaos suite: FHH_DEBUG_TAINT sanitizer stage FAILED" >&2
+    rc=1
+fi
+
 # fhh-trace stage: re-run one e2e chaos-recovery leg with distributed
 # tracing ON, then merge + structurally validate the trace — a recovery
 # wave (reconnect replays, plane resets, level re-runs) must still
@@ -75,7 +91,7 @@ if [ $trace_rc -ne 0 ]; then
 fi
 rm -rf "$trace_dir"
 
-python - "$report" "$artifact" "$guards_rc" "$trace_rc" <<'EOF'
+python - "$report" "$artifact" "$guards_rc" "$trace_rc" "$taint_rc" <<'EOF'
 import json, sys
 import xml.etree.ElementTree as ET
 
@@ -99,6 +115,7 @@ doc = {
     "duration_s": round(float(suite.get("time", 0)), 2),
     "debug_guards": "passed" if sys.argv[3] == "0" else "failed",
     "trace_validation": "passed" if sys.argv[4] == "0" else "failed",
+    "debug_taint": "passed" if sys.argv[5] == "0" else "failed",
     "tests": tests,
 }
 json.dump(doc, open(sys.argv[2], "w"), indent=1)
@@ -106,7 +123,8 @@ print(
     f"chaos suite: {doc['passed']} passed, {doc['failed']} failed, "
     f"{doc['skipped']} skipped in {doc['duration_s']}s, "
     f"debug_guards={doc['debug_guards']}, "
-    f"trace_validation={doc['trace_validation']} -> {sys.argv[2]}"
+    f"trace_validation={doc['trace_validation']}, "
+    f"debug_taint={doc['debug_taint']} -> {sys.argv[2]}"
 )
 EOF
 rm -f "$report"

@@ -20,18 +20,20 @@ python -m fuzzyheavyhitters_tpu.analysis \
 python - "$artifact" <<'EOF'
 import json, sys
 doc = json.load(open(sys.argv[1]))
-# the artifact must prove the interprocedural fhh-race pass ran (the
-# rule list is part of the report schema exactly for this assert)
+# the artifact must prove the interprocedural fhh-race AND fhh-taint
+# passes ran (the rule list is part of the report schema exactly for
+# this assert)
 race = {"guarded-state-unlocked", "stale-read-across-await"}
-missing = race - set(doc.get("rules", []))
+taint = {"secret-to-sink-flow", "secret-branch", "unmasked-wire"}
+missing = (race | taint) - set(doc.get("rules", []))
 if missing:
-    print(f"fhh-lint: fhh-race pass MISSING from artifact: {sorted(missing)}")
+    print(f"fhh-lint: interprocedural pass MISSING from artifact: {sorted(missing)}")
     sys.exit(1)
 print(
     f"fhh-lint: {len(doc['findings'])} new, "
     f"{doc['baselined']} baselined, "
     f"{len(doc['stale_baseline'])} stale baseline entries, "
-    f"fhh-race pass active "
+    f"fhh-race + fhh-taint passes active "
     f"-> {sys.argv[1]}"
 )
 EOF

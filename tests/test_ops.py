@@ -24,6 +24,7 @@ import asyncio
 import glob
 import json
 import os
+import re
 import signal
 import socket
 import subprocess
@@ -690,6 +691,110 @@ def test_ops_e2e_disabled_binds_no_socket(tmp_path):
     finally:
         for p in (s0, s1):
             if p.poll() is None:
+                p.kill()
+
+
+_SWEEP_LEXICON = {
+    # the unambiguous subset of the lint secret_lexicon: "delta" and
+    # "label"/"labels" are legitimate ops vocabulary on the telemetry
+    # plane (fhh_hbm_delta_bytes; Prometheus labels) — the rest may
+    # never name an exported series, label, or report row
+    "seed", "seeds", "cw", "cws", "cwf", "cwv", "mac", "secret", "triples",
+}
+
+
+def _lexicon_hits(text):
+    segs = [s for s in re.split(r"[^a-z0-9]+", str(text).lower()) if s]
+    return [s for s in segs if s in _SWEEP_LEXICON]
+
+
+def _sweep_json(doc, path=""):
+    hits = []
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            hits += [(f"{path}.{k}", h) for h in _lexicon_hits(k)]
+            hits += _sweep_json(v, f"{path}.{k}")
+    elif isinstance(doc, (list, tuple)):
+        for i, v in enumerate(doc):
+            hits += _sweep_json(v, f"{path}[{i}]")
+    elif isinstance(doc, str):
+        hits += [(path, h) for h in _lexicon_hits(doc)]
+    return hits
+
+
+@pytest.mark.slow  # ~40 s: three subprocess JAX boots (secure data plane)
+def test_ops_e2e_taint_sweep_secure_crawl(tmp_path):
+    """The fhh-taint acceptance sweep: a live three-process SECURE crawl
+    under ``FHH_DEBUG_TAINT=1`` — every source constructor registers its
+    buffer in the server processes and every obs sink boundary asserts
+    in-process (a registered byte image crossing any exported surface
+    would crash the crawl) — then the scraped /metrics planes and the
+    run reports are swept from the OUTSIDE: no exported metric name,
+    label key, label value, or report row may match the secret lexicon.
+    The small resilience-suite shape keeps the CPU data plane fast."""
+    port, mport = E2E_PORT + 40, E2E_METRICS + 6
+    cfg = {
+        "data_len": 5, "n_dims": 1, "ball_size": 1, "addkey_batch_size": 64,
+        "num_sites": 4, "threshold": 0.05, "zipf_exponent": 1.0,
+        "server0": f"127.0.0.1:{port}", "server1": f"127.0.0.1:{port + 10}",
+        "distribution": "zipf", "f_max": 16, "backend": "cpu",
+        "secure_exchange": True,
+    }
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps(cfg))
+    report_path = tmp_path / "leader_report.json"
+    common = dict(
+        FHH_DEBUG_TAINT=1,
+        FHH_RUN_REPORT=report_path,
+        FHH_METRICS_PORT=mport,
+        FHH_ALERT_LEVEL_P95_S="1000",
+    )
+    env = _e2e_env(tmp_path, **common)
+    srv = "fuzzyheavyhitters_tpu.bin.server"
+    s1 = _spawn(srv, cfg_path, tmp_path, env, "--server_id", "1")
+    s0 = _spawn(srv, cfg_path, tmp_path, env, "--server_id", "0")
+    lead = None
+    try:
+        lead = _spawn(
+            "fuzzyheavyhitters_tpu.bin.leader", cfg_path, tmp_path, env,
+            "-n", "16",
+        )
+        out, _ = lead.communicate(timeout=540)
+        # the in-process half of the sweep: with the sanitizer live on
+        # all three processes, a registered buffer reaching ANY sink
+        # boundary raises TaintViolation and the crawl dies
+        assert lead.returncode == 0, f"leader failed:\n{out[-4000:]}"
+        assert "TaintViolation" not in out
+        scrapes = {
+            sid: fhhops.scrape(f"127.0.0.1:{mport + 1 + i}")
+            for i, sid in enumerate(("s0", "s1"))
+        }
+        for p in (s0, s1):
+            p.terminate()
+        outs = {}
+        for sid, p in (("s0", s0), ("s1", s1)):
+            outs[sid], _ = p.communicate(timeout=60)
+            assert "TaintViolation" not in outs[sid]
+        # the outside half: sweep every exported surface for lexicon
+        # matches — a series or label NAMED like key material is a leak
+        # in the making even when today's bytes are clean
+        for sid, samples in scrapes.items():
+            assert samples, f"no samples scraped from {sid}"
+            for name, labels, _v in samples:
+                assert not _lexicon_hits(name), (sid, name)
+                for k, v in labels.items():
+                    assert not _lexicon_hits(k), (sid, name, k)
+                    assert not _lexicon_hits(v), (sid, name, k, v)
+        # and the session rows the servers persisted at SIGTERM
+        for sid in ("s0", "s1"):
+            srep_path = tmp_path / f"leader_report.{sid}.json"
+            srep = json.loads(srep_path.read_text())
+            assert "registries" in srep
+            hits = _sweep_json(srep)
+            assert not hits, (sid, hits[:5])
+    finally:
+        for p in (s0, s1, lead):
+            if p is not None and p.poll() is None:
                 p.kill()
 
 

@@ -1,0 +1,541 @@
+"""fhh-taint: the interprocedural secret-flow pass (analysis/taint.py)
+and its runtime shadow-taint twin (utils/taint_guard.py).
+
+Four layers, cheapest first:
+
+- static fixtures: per-rule positive/negative cases — multi-hop flows
+  through helper returns, containers, and f-strings; secret-branch on a
+  host bool; declassifier calls clearing taint; verified vs unverified
+  ``declassified(reason)`` contracts;
+- repo properties: the tree self-analyzes at ZERO with all three rules
+  strict; lexical ``secret-to-sink`` findings inside taint_modules are
+  a subset of the flow rule's (the supersession invariant); the three
+  config copies (pyproject ``[tool.fhh-lint.taint]``,
+  ``config._DEFAULT_TAINT``, ``taint_guard._DEFAULT_SOURCES``) cannot
+  drift;
+- runtime sanitizer units: register/check/declassified/reset, and the
+  deliberate-injection legs — a secret pushed into the exporter's
+  exposition document or a log line RAISES TaintViolation;
+- the e2e leg: a real socket crawl (trusted AND secure) runs green
+  under ``FHH_DEBUG_TAINT=1`` with the source constructors armed.
+
+Shapes mirror tests/test_resilience.py (L=5, d=1) so the crawl kernels
+compile once across the suites.
+"""
+
+import asyncio
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax  # noqa: F401  (backend selection happens via conftest fixtures)
+
+from fuzzyheavyhitters_tpu.analysis import (
+    LintConfig,
+    lint_paths,
+    lint_source,
+    load_config,
+)
+from fuzzyheavyhitters_tpu.analysis.config import _DEFAULT_TAINT
+from fuzzyheavyhitters_tpu.analysis.rules import RULES_BY_NAME
+from fuzzyheavyhitters_tpu.utils import taint_guard
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TAINT_RULE_NAMES = ("secret-to-sink-flow", "secret-branch", "unmasked-wire")
+
+
+def _lint(src, relpath="fuzzyheavyhitters_tpu/protocol/fake.py", cfg=None,
+          rule=None):
+    cfg = cfg or LintConfig()
+    rules = [RULES_BY_NAME[rule]] if rule else None
+    return lint_source(textwrap.dedent(src), relpath, cfg, rules)
+
+
+def _names(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# rule: secret-to-sink-flow (static fixtures)
+# ---------------------------------------------------------------------------
+
+
+def test_flow_multi_hop_helper_container_fstring():
+    """The tentpole shape: a declared source read flows through a helper
+    RETURN, a dict, and an f-string hole into logs.emit — three hops the
+    lexical rule cannot see (nothing at the sink is named like a
+    secret).  The finding lands at the sink call site."""
+    src = """
+    from ..obs import logs
+
+    def _bundle(sess):
+        k = derive_seed(sess._sec_seed, "gc", 0, 0)
+        return {"k": k}
+
+    def run(sess):
+        b = _bundle(sess)
+        logs.emit("crawl.window", detail=f"material={b['k']}")
+    """
+    fs = _lint(src, rule="secret-to-sink-flow")
+    assert _names(fs) == ["secret-to-sink-flow"]
+    assert fs[0].line == 10
+
+
+def test_flow_call_site_surfacing_into_leaking_helper():
+    """An argument fed to a callee that forwards its parameter to a sink
+    is a finding at the CALL SITE (interprocedural summaries)."""
+    src = """
+    from ..obs import logs
+
+    def _log_it(payload):
+        logs.emit("debug.payload", data=payload)
+
+    def run(sess):
+        _log_it(sess._sketch_seed)
+    """
+    fs = _lint(src, rule="secret-to-sink-flow")
+    assert len(fs) == 1
+    assert fs[0].line == 8  # the _log_it(...) call, not the emit
+
+
+def test_flow_raise_is_a_sink():
+    src = """
+    def run(sess):
+        s = ratchet_seed(sess, 3)
+        raise ValueError(f"bad state: {s}")
+    """
+    fs = _lint(src, rule="secret-to-sink-flow")
+    assert len(fs) == 1 and "exception" in fs[0].message
+
+
+def test_flow_declassifier_clears_taint():
+    """A declared declassifier (pad-XOR encryption, share opening) is a
+    masking boundary: its return is public by protocol argument."""
+    src = """
+    from ..obs import logs
+
+    def run(sess, pads):
+        ct = ot2s_encrypt(sess._sec_seed, pads)
+        logs.emit("gc.sent", ct=ct)
+    """
+    assert _lint(src, rule="secret-to-sink-flow") == []
+
+
+def test_flow_metadata_and_none_checks_clean():
+    """Shape/dtype/nbytes reads and `is None` tests carry no secret
+    bytes — the precision carve-outs that let real obs code log buffer
+    geometry without tripping the flow rule."""
+    src = """
+    from ..obs import logs
+
+    def run(sess):
+        seed = sess._sec_seed
+        if seed is None:
+            return
+        logs.emit("gc.geom", shape=str(seed.shape), n=seed.nbytes)
+    """
+    assert _lint(src, rule="secret-to-sink-flow") == []
+
+
+def test_flow_inline_source_marker():
+    """`# fhh-taint: source` taints an assignment without a table entry
+    — the annotation path for module-local secrets."""
+    src = """
+    from ..obs import logs
+
+    def run(blob):
+        key = blob[3:]  # fhh-taint: source
+        logs.emit("x", k=key)
+    """
+    fs = _lint(src, rule="secret-to-sink-flow")
+    assert len(fs) == 1 and fs[0].line == 6
+
+
+# ---------------------------------------------------------------------------
+# rule: secret-branch
+# ---------------------------------------------------------------------------
+
+
+def test_secret_branch_on_host_bool():
+    src = """
+    def run(sess):
+        s = sess._sec_seed
+        if s[0] == 3:
+            return 1
+        return 0
+    """
+    fs = _lint(src, rule="secret-branch")
+    assert _names(fs) == ["secret-branch"]
+    assert fs[0].line == 4
+
+
+def test_secret_branch_none_check_clean():
+    src = """
+    def run(sess):
+        s = sess._sec_seed
+        if s is not None:
+            return 1
+        return 0
+    """
+    assert _lint(src, rule="secret-branch") == []
+
+
+def test_secret_branch_through_helper_param():
+    """Branching on a parameter that receives tainted data at a call
+    site surfaces at the call site (summary: branch_params)."""
+    src = """
+    def _route(flag):
+        if flag:
+            return 1
+        return 0
+
+    def run(sess):
+        return _route(sess._sec_seed[0] > 0)
+    """
+    fs = _lint(src, rule="secret-branch")
+    assert len(fs) == 1 and fs[0].line == 8
+
+
+# ---------------------------------------------------------------------------
+# rule: unmasked-wire
+# ---------------------------------------------------------------------------
+
+
+def test_unmasked_wire_raw_seed_send():
+    src = """
+    class OtExtSender:
+        def leak(self):
+            self._send(self._seeds)
+    """
+    fs = _lint(src, rule="unmasked-wire")
+    assert _names(fs) == ["unmasked-wire"]
+    assert fs[0].line == 4
+
+
+def test_unmasked_wire_masked_send_clean():
+    src = """
+    class OtExtSender:
+        def ok(self, pads):
+            self._send(ot2s_encrypt(self._seeds, pads))
+    """
+    assert _lint(src, rule="unmasked-wire") == []
+
+
+# ---------------------------------------------------------------------------
+# declassified(reason) contracts: checked, never trusted
+# ---------------------------------------------------------------------------
+
+
+def test_declassified_contract_verified_suppresses():
+    """A contract naming a declared declassifier THAT IS CALLED in the
+    enclosing function covers the finding on its line."""
+    src = """
+    from ..obs import logs
+
+    def run(sess, shares):
+        opened = ev_open_level(shares)
+        seed = sess._sec_seed
+        logs.emit("lvl", v=opened, s=str(seed))  # fhh-taint: declassified(ev_open_level)
+    """
+    assert _lint(src, rule="secret-to-sink-flow") == []
+
+
+def test_declassified_contract_op_not_called_is_finding():
+    """Naming a real declassifier that is NOT on the path is itself a
+    finding — and does not cover the flow finding."""
+    src = """
+    from ..obs import logs
+
+    def run(sess):
+        seed = sess._sec_seed
+        logs.emit("lvl", s=str(seed))  # fhh-taint: declassified(np_add)
+    """
+    fs = _lint(src, rule="secret-to-sink-flow")
+    assert len(fs) == 2
+    assert any("never called" in f.message for f in fs)
+
+
+def test_declassified_contract_unknown_reason_is_finding():
+    src = """
+    from ..obs import logs
+
+    def run(x):
+        logs.emit("lvl", v=x)  # fhh-taint: declassified(because I said so)
+    """
+    fs = _lint(src, rule="secret-to-sink-flow")
+    assert len(fs) == 1
+    assert "names no declared declassifier" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# repo properties: self-analysis at zero, supersession subset, no drift
+# ---------------------------------------------------------------------------
+
+
+def test_repo_self_taint_analysis_zero():
+    """The tree is CLEAN under all three flow rules with no baseline
+    entries — the tier-1 enforcement point for this subsystem (the full
+    self-lint in test_analysis.py covers every rule; this one isolates
+    the taint pass so its failures read as taint failures)."""
+    cfg = load_config(REPO)
+    rules = [RULES_BY_NAME[n] for n in TAINT_RULE_NAMES]
+    findings, errors = lint_paths(
+        [os.path.join(REPO, "fuzzyheavyhitters_tpu")], cfg, REPO, rules
+    )
+    assert errors == []
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_lexical_subset_of_flow_in_taint_modules():
+    """Supersession invariant: inside taint_modules, every site the
+    lexical secret-to-sink rule flags on the REAL tree must also be
+    flagged by the flow rule — the lexical rule stays as a pre-filter
+    and may never be the only thing standing between a true leak and
+    the baseline.  (Fixtures where lexical fires and flow does not —
+    e.g. logging `seed.shape` — are the flow rule's precision WINS,
+    which is exactly why real findings must come from the flow rule.)"""
+    cfg = load_config(REPO)
+    scope = [os.path.join(REPO, m) for m in cfg.taint_modules]
+    lex, _ = lint_paths(scope, cfg, REPO, [RULES_BY_NAME["secret-to-sink"]])
+    flow, _ = lint_paths(
+        scope, cfg, REPO, [RULES_BY_NAME["secret-to-sink-flow"]]
+    )
+    lex_sites = {(f.path, f.line) for f in lex}
+    flow_sites = {(f.path, f.line) for f in flow}
+    assert lex_sites <= flow_sites, lex_sites - flow_sites
+
+
+def test_lexical_and_flow_agree_on_a_true_leak():
+    """The subset property is not vacuous: on a genuine leak where the
+    sink argument is NAMED like a secret, both rules fire at the same
+    line."""
+    src = """
+    from ..obs import logs
+
+    def run(sess):
+        seed = sess._sec_seed
+        logs.emit("oops", seed=seed)
+    """
+    lex = _lint(src, rule="secret-to-sink")
+    flow = _lint(src, rule="secret-to-sink-flow")
+    assert [f.line for f in lex] == [f.line for f in flow] == [6]
+
+
+def test_taint_config_three_way_drift():
+    """The three copies of the source model cannot drift:
+
+    - pyproject ``[tool.fhh-lint.taint]`` (the operative copy) must load
+      to exactly ``config._DEFAULT_TAINT`` (the in-tree default);
+    - every runtime-registrable source in
+      ``taint_guard._DEFAULT_SOURCES`` must be a DECLARED attr source
+      (the static pass knows strictly more than the sanitizer — fn
+      returns and device-resident state it cannot byte-match);
+    - the scalar knobs (modules/sinks/wire/declassifiers) in pyproject
+      must match the LintConfig defaults."""
+    cfg = load_config(REPO)
+    assert cfg.taint == _DEFAULT_TAINT
+    runtime = set(taint_guard._DEFAULT_SOURCES)
+    declared_attrs = {k for k in cfg.taint if "." in k}
+    assert runtime <= declared_attrs, runtime - declared_attrs
+    defaults = LintConfig()
+    for knob in ("taint_modules", "taint_sinks", "taint_wire_calls",
+                 "taint_declassifiers"):
+        assert getattr(cfg, knob) == getattr(defaults, knob), knob
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer: register / check / declassified / reset
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    """Sanitizer ON with a fresh registry; always disarmed after."""
+    monkeypatch.setenv("FHH_DEBUG_TAINT", "1")
+    taint_guard.reset()
+    yield
+    taint_guard.reset()
+
+
+def _secret():
+    return np.frombuffer(os.urandom(32), dtype=np.uint8)
+
+
+def test_guard_off_is_inert(monkeypatch):
+    monkeypatch.delenv("FHH_DEBUG_TAINT", raising=False)
+    taint_guard.reset()
+    s = _secret()
+    taint_guard.register("CollectionSession._sec_seed", s)
+    assert not taint_guard._armed
+    taint_guard.check(s.tobytes(), sink="anywhere")  # no-op, no raise
+
+
+def test_guard_catches_bytes_containment_hex_and_repr(armed):
+    s = _secret()
+    taint_guard.register("CollectionSession._sec_seed", s)
+    raw = s.tobytes()
+    for payload in (
+        raw,                                  # byte-equal
+        b"frame:" + raw + b":tail",           # byte-contained
+        f"v={raw.hex()}",                     # hex interpolation
+        f"arr={s}",                           # str(ndarray) interpolation
+        {"lines": ["ok", {"deep": raw}]},     # nested containers
+    ):
+        with pytest.raises(taint_guard.TaintViolation) as ei:
+            taint_guard.check(payload, sink="metrics-render")
+        assert "CollectionSession._sec_seed" in str(ei.value)
+    # innocent payloads pass
+    taint_guard.check("all clear", sink="metrics-render")
+    taint_guard.check({"n": 7, "rows": [os.urandom(8)]}, sink="x")
+
+
+def test_guard_declassified_window_and_reason(armed):
+    s = _secret()
+    taint_guard.register("OtExtSender._seeds", s)
+    with taint_guard.declassified("ot2s_encrypt pads cover this frame"):
+        taint_guard.check(s.tobytes(), sink="wire")  # sanctioned
+    with pytest.raises(taint_guard.TaintViolation):
+        taint_guard.check(s.tobytes(), sink="wire")  # window closed
+    with pytest.raises(ValueError):
+        taint_guard.declassified("   ")
+
+
+def test_guard_reset_disarms(armed):
+    s = _secret()
+    taint_guard.register("OtExtReceiver._seeds0", s)
+    taint_guard.reset()
+    taint_guard.check(s.tobytes(), sink="wire")  # registry gone
+
+
+def test_guard_short_scalars_not_text_marked(armed):
+    """A tiny buffer gets byte markers but no str() text marker (a
+    2-char repr would trip on unrelated digits in any rendered line)."""
+    tiny = np.frombuffer(b"\x07", dtype=np.uint8)
+    taint_guard.register("CollectionSession._sketch_seed", tiny)
+    taint_guard.check("value=7 elsewhere", sink="log-emit")  # no raise
+    with pytest.raises(taint_guard.TaintViolation):
+        taint_guard.check(b"\x07", sink="log-emit")  # bytes still caught
+
+
+# ---------------------------------------------------------------------------
+# deliberate injection: the obs sink boundaries really assert
+# ---------------------------------------------------------------------------
+
+
+def test_injection_exporter_render_raises(armed):
+    from fuzzyheavyhitters_tpu.obs import exporter
+
+    s = _secret()
+    taint_guard.register("CollectionSession._sec_seed", s)
+    leak = f'fhh_debug_dump{{blob="{s.tobytes().hex()}"}} 1'
+    exporter.add_producer(lambda: [leak])
+    try:
+        with pytest.raises(taint_guard.TaintViolation) as ei:
+            exporter.render()
+        assert "metrics-render" in str(ei.value)
+    finally:
+        with exporter._lock:
+            exporter._producers.clear()
+    # with the leak gone the document renders (and is scanned) fine
+    assert exporter.render() is not None
+
+
+def test_injection_log_emit_raises(armed):
+    from fuzzyheavyhitters_tpu.obs import logs
+
+    s = _secret()
+    taint_guard.register("CollectionSession._ratchet_digest", s)
+    with pytest.raises(taint_guard.TaintViolation) as ei:
+        logs.emit("debug.dump", blob=s.tobytes().hex())
+    assert "log-emit" in str(ei.value)
+    logs.emit("debug.dump", blob="0000")  # clean lines still flow
+
+
+# ---------------------------------------------------------------------------
+# e2e: a real socket crawl under FHH_DEBUG_TAINT=1
+# ---------------------------------------------------------------------------
+
+BASE_PORT = 27531
+
+
+def _cfg(port, **kw):
+    from fuzzyheavyhitters_tpu.utils.config import Config
+
+    base = dict(
+        data_len=5, n_dims=1, ball_size=1, addkey_batch_size=64,
+        num_sites=4, threshold=0.05, zipf_exponent=1.0,
+        server0=f"127.0.0.1:{port}", server1=f"127.0.0.1:{port + 10}",
+        distribution="zipf", f_max=16, backend="cpu",
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def _client_keys(seed, L, n):
+    from fuzzyheavyhitters_tpu.ops import ibdcf
+
+    r = np.random.default_rng(seed)
+    sites = r.integers(0, 1 << L, size=4)
+    pts = sites[r.integers(0, 4, size=n)]
+    pts_bits = (
+        ((pts[:, None, None] >> np.arange(L - 1, -1, -1)) & 1) > 0
+    )
+    return ibdcf.gen_l_inf_ball(pts_bits, 1, r, engine="np")
+
+
+@pytest.mark.parametrize("secure", [False, True], ids=["trusted", "secure"])
+def test_e2e_crawl_green_under_taint_sanitizer(
+    cpu_default, monkeypatch, secure
+):
+    """The whole point of the runtime twin: the REAL protocol — session
+    handshake, (secure: base-OT + IKNP + GC data plane), crawl, sketch
+    verify — runs green with every obs sink boundary asserting, because
+    nothing the protocol legitimately renders contains registered
+    source bytes.  The secure leg proves the OT/session constructors
+    actually armed the sanitizer in-process."""
+    from fuzzyheavyhitters_tpu.protocol import rpc
+    from fuzzyheavyhitters_tpu.protocol.leader_rpc import RpcLeader
+
+    monkeypatch.setenv("FHH_DEBUG_TAINT", "1")
+    taint_guard.reset()
+    port = BASE_PORT + (0 if secure else 40)
+    cfg = _cfg(port, secure_exchange=secure)
+    k0, k1 = _client_keys(1234, 5, 12)
+
+    async def run():
+        s0 = rpc.CollectorServer(0, cfg)
+        s1 = rpc.CollectorServer(1, cfg)
+        t1 = asyncio.create_task(
+            s1.start("127.0.0.1", port + 10, "127.0.0.1", port + 11)
+        )
+        await asyncio.sleep(0.05)
+        t0 = asyncio.create_task(
+            s0.start("127.0.0.1", port, "127.0.0.1", port + 11)
+        )
+        await asyncio.gather(t0, t1)
+        c0 = await rpc.CollectorClient.connect("127.0.0.1", port)
+        c1 = await rpc.CollectorClient.connect("127.0.0.1", port + 10)
+        lead = RpcLeader(cfg, c0, c1)
+        await lead._both("reset")
+        await lead.upload_keys(k0, k1)
+        res = await lead.run(12)
+        for c in (c0, c1):
+            await c.aclose()
+        for s in (s0, s1):
+            await s.aclose()
+        return res
+
+    try:
+        res = asyncio.run(run())
+        assert res is not None
+        if secure:
+            # the source constructors really registered (session seed,
+            # coin flip, OT endpoint state) — the crawl above exercised
+            # every sink boundary with the sanitizer live
+            assert taint_guard._armed
+            assert taint_guard._byte_markers
+    finally:
+        taint_guard.reset()
