@@ -2222,8 +2222,24 @@ def main(argv=None):
         help="comma list of leg names to run; the rest report "
              '{"skipped": "sections"}',
     )
+    ap.add_argument(
+        "--force", action="store_true",
+        help="overwrite a non-empty --out artifact (without this, an "
+             "existing results file is refused unless --resume extends "
+             "it)",
+    )
     args = ap.parse_args(argv)
     _OUT = args.out
+    if (
+        not args.force
+        and not args.resume
+        and os.path.exists(_OUT)
+        and os.path.getsize(_OUT) > 0
+    ):
+        ap.error(
+            f"{_OUT} already holds results — pass --resume to extend "
+            "it or --force to overwrite"
+        )
     only = (
         {s.strip() for s in args.sections.split(",") if s.strip()}
         if args.sections

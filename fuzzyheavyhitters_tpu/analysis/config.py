@@ -51,6 +51,10 @@ _DEFAULT_GUARDS = {
     "CollectionSession._sketch_root": "_verb_lock",
     "CollectionSession._ratchet_digest": "_verb_lock",
     "CollectionSession._window_sketch_root": "_verb_lock",
+    # fleet migration stamps (the session_export/session_import verbs
+    # dispatch under the session's _verb_lock)
+    "CollectionSession._export_epoch": "_verb_lock",
+    "CollectionSession._import_seen": "_verb_lock",
     # CollectorServer infra: the replay-dedup session table
     "CollectorServer._sessions": "_verb_lock",
     # WindowedIngest: gate-order == mirror-order state serializes on
@@ -60,6 +64,10 @@ _DEFAULT_GUARDS = {
     "WindowedIngest._journal": "_submit_lock",
     "WindowedIngest._journaled": "_submit_lock",
     "WindowedIngest._sealed": "_submit_lock",
+    # FleetDirectory (protocol/fleet.py): host-pair rows + session
+    # placements serialize on the directory's own asyncio lock
+    "FleetDirectory._hosts": "_lock",
+    "FleetDirectory._placements": "_lock",
 }
 
 
@@ -78,6 +86,10 @@ _DEFAULT_TAINT = {
     "CollectionSession._sketch_seed": "sketch challenge coin (server-server secret)",
     "CollectionSession._ratchet_digest": "crawl transcript ratchet digest",
     "CollectionSession._last_shares": "expanded field share planes",
+    # reconstructed migration payload (protocol/rpc.py session_import
+    # rebuilds every pool buffer it lands under this label)
+    "CollectionSession._imported_pool_shares":
+        "migrated ingest-pool key shares (session_import)",
     # ibDCF/DPF key material (protocol/sketch.py)
     "SketchKeyBatch.root_seed": "sketch DPF root seeds",
     # IKNP OT-extension endpoint state (ops/otext.py)

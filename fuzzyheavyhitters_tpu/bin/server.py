@@ -20,7 +20,9 @@ restart-from-scratch.
 from __future__ import annotations
 
 import asyncio
+import json
 import os
+import time
 
 from .. import obs
 from ..protocol.rpc import CollectorServer
@@ -31,6 +33,37 @@ from ..utils import config as configmod
 def _split(addr: str) -> tuple[str, int]:
     host, port = addr.rsplit(":", 1)
     return host, int(port)
+
+
+def _fleet_register(server, server_id: int, host: str, port: int) -> None:
+    """Drop this server half's registration row into the shared fleet
+    directory (``FHH_FLEET`` names the dir; ``FHH_FLEET_PAIR`` names the
+    host pair, default ``pair0``).  ``FleetDirectory.scan`` folds the two
+    ``<pair>_s<id>.json`` halves into one :class:`HostPair` row; the boot
+    id is what the supervisor's liveness probe compares against, so a
+    restarted process re-registers as a NEW boot.  Atomic tmp+rename: a
+    scan never reads a torn row."""
+    fleet_dir = os.environ.get("FHH_FLEET")
+    if not fleet_dir:
+        return
+    os.makedirs(fleet_dir, exist_ok=True)
+    pair = os.environ.get("FHH_FLEET_PAIR") or "pair0"
+    row = {
+        "pair": pair,
+        "server_id": server_id,
+        "host": host,
+        "port": port,
+        "boot_id": server._boot_id,
+        "capacity": int(os.environ.get("FHH_FLEET_CAPACITY", "4")),
+        "ts": round(time.time(), 3),
+    }
+    path = os.path.join(fleet_dir, f"{pair}_s{server_id}.json")
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(row, f)
+    os.replace(tmp, path)
+    obs.emit("fleet.registered", pair=pair, server=server_id,
+             boot_id=server._boot_id)
 
 
 async def amain(cfg, server_id: int) -> None:
@@ -82,6 +115,9 @@ async def amain(cfg, server_id: int) -> None:
             server_id, cfg, ckpt_dir=ckpt_dir, _mesh_chaos=mesh_chaos
         )
         srv = await server.start(my_host, my_port, peer_host, peer_port)
+        # fleet directory registration (protocol/fleet.py): after start so
+        # the row only ever advertises a pair that is actually listening
+        _fleet_register(server, server_id, my_host, my_port)
         obs.emit("server.serving", server=server_id, host=my_host, port=my_port)
         async with srv:
             await srv.serve_forever()

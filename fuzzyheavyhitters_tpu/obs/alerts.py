@@ -27,6 +27,9 @@ rule                    threshold env               fires when
                         (0.9)                       fraction (skipped when
                                                     the runtime reports no
                                                     capacity — XLA:CPU)
+``migration_stuck``     ``FHH_ALERT_MIGRATION_``    a fleet migration's
+                        ``STUCK_S`` (120)           inflight gauge older
+                                                    than the budget
 ==========================================================================
 
 Fire-once discipline: an alert is keyed ``(rule, subject)`` and emits
@@ -59,6 +62,7 @@ ENV_STALL_S = ("FHH_ALERT_STALL_S", 120.0)
 ENV_LEVEL_P95_S = ("FHH_ALERT_LEVEL_P95_S", 2.0)
 ENV_BACKLOG_KEYS = ("FHH_ALERT_BACKLOG_KEYS", 100000.0)
 ENV_HBM_FRAC = ("FHH_ALERT_HBM_FRAC", 0.9)
+ENV_MIGRATION_STUCK_S = ("FHH_ALERT_MIGRATION_STUCK_S", 120.0)
 
 _MAX_FIRED = 256  # rollup bound: alerts are transitions, not a log
 
@@ -128,6 +132,20 @@ def evaluate_registries(regs=None) -> None:
                 in_use_bytes=int(in_use), limit_bytes=int(limit),
                 frac=round(in_use / limit, 4), budget_frac=hbm_frac,
             )
+        # stuck migration: the fleet placer sets this gauge to the
+        # attempt's start instant and clears it to 0 on ANY outcome
+        # (protocol/fleet.py) — a nonzero value older than the budget
+        # means a transfer wedged mid-flight (source still authoritative,
+        # destination half-imported: operator attention, not silence)
+        since = reg.gauge_value("migration_inflight_since")
+        if since:
+            stuck_s = _threshold(ENV_MIGRATION_STUCK_S)
+            age = time.time() - float(since)
+            if age > stuck_s:
+                _fire(
+                    "migration_stuck", reg.name,
+                    inflight_s=round(age, 3), budget_s=stuck_s,
+                )
 
 
 def evaluate_sessions(rows: dict, source: str) -> None:

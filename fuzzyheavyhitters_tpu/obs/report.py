@@ -139,6 +139,9 @@ def run_report(registries=None) -> dict:
     ing = _ingest_summary(out)
     if ing is not None:
         doc["ingest"] = ing
+    fleet = _fleet_summary(out)
+    if fleet is not None:
+        doc["fleet"] = fleet
     mesh = _mesh_summary(out)
     if mesh is not None:
         doc["mesh"] = mesh
@@ -443,6 +446,36 @@ def _ingest_summary(registries: dict) -> dict | None:
             sums["ingest_admitted"] / ingest_s, 2
         ) if ingest_s > 0 else None,
         "window_crawl_seconds": round(crawl_s, 6),
+    }
+
+
+def _fleet_summary(registries: dict) -> dict | None:
+    """Cross-registry fleet rollup (protocol/fleet.py): placement
+    decisions, live migrations and whole-host failovers (the placer's
+    ``fleet`` registry), plus the per-server ``session_exports`` /
+    ``session_imports`` verb counters and the driver-side journal
+    replays that made each transfer exactly-once.  Present only when a
+    fleet operation happened — single-pair runs omit the section."""
+    names = ("placement_decisions", "session_migrations",
+             "session_failovers", "session_exports", "session_imports",
+             "ingest_migrations", "ingest_failovers", "sessions_retired")
+    sums = dict.fromkeys(names, 0)
+    seen = False
+    for snap in registries.values():
+        counters = snap.get("counters", {})
+        for n in names:
+            if n in counters:
+                seen = True
+                sums[n] += counters[n].get("total", 0)
+    if not seen:
+        return None
+    return {
+        "placement_decisions": sums["placement_decisions"],
+        "session_migrations": sums["session_migrations"],
+        "session_failovers": sums["session_failovers"],
+        "session_exports": sums["session_exports"],
+        "session_imports": sums["session_imports"],
+        "sessions_retired": sums["sessions_retired"],
     }
 
 

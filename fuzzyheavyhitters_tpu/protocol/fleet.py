@@ -1,0 +1,363 @@
+"""Collector fleet: host-pair directory, placement, migration, failover.
+
+The paper's protocol runs one collection against exactly TWO collector
+servers (PAPER.md §0).  Everything below the leader already survives a
+single restarted server (PR 3/4/8: reconnect replays, plane resets,
+checkpoint re-seed), but a collection still dies with its host *pair*,
+and a hot pair has no way to shed tenants.  This module adds the fleet
+layer above the pair:
+
+- :class:`FleetDirectory` — N collector host pairs register here (boot
+  ids, capacity, per-session ``last_progress_s`` / stall-fill load
+  signals sourced from each server's :class:`~.tenancy.TenantScheduler`
+  via ``status``).  Registration is file-based so out-of-process
+  servers can join: ``bin/server.py`` drops
+  ``<FHH_FLEET>/<pair>_s<id>.json`` atomically at boot and ``scan()``
+  folds the halves into pair rows.  In-process tests register pairs
+  directly.
+- :class:`FleetPlacer` — the leader-side scheduler.  ``place()`` puts a
+  new collection on the least-loaded pair; ``migrate()`` moves a LIVE
+  session between pairs mid-stream (quiesce at a window/level boundary,
+  ``session_export`` on the source, ``session_import`` on the
+  destination, journal replay for exactly-once ingest, ratchet replay
+  for challenge identity — the heavy lifting lives in
+  ``WindowedIngest.migrate``); ``failover()`` is the same machinery
+  driven by a dead boot id on probe, importing the orphaned session's
+  NEWEST checkpoint on a surviving pair.
+
+Load model: a pair's load is ``placed / capacity`` plus the freshest
+probed stall pressure (stall-fill ratio says the pair's device is
+already timesharing; a stale ``last_progress_s`` says some tenant is
+wedged and the pair is suspect).  Deliberately scalar — placement only
+needs a total order, not a simulator.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import os
+import time
+
+from ..obs import metrics
+from ..obs import logs
+from ..utils import guards
+
+
+@dataclasses.dataclass
+class HostPair:
+    """One registered collector pair (two servers = one protocol unit)."""
+
+    name: str
+    host0: str = ""
+    port0: int = 0
+    host1: str = ""
+    port1: int = 0
+    boot0: str = ""
+    boot1: str = ""
+    capacity: int = 4
+    alive: bool = True
+    # freshest probed load signals (tenancy.TenantScheduler.stats + the
+    # per-session last_progress age off the pair's status verb)
+    stall_fill_ratio: float = 0.0
+    max_progress_age_s: float = 0.0
+    last_seen_s: float = 0.0
+
+    def addr(self, which: int) -> tuple:
+        return (self.host0, self.port0) if which == 0 else (self.host1, self.port1)
+
+
+# static twin of the runtime guard map armed below (pyproject
+# [tool.fhh-lint.guards] carries the same rows; the drift test in
+# tests/test_concurrency.py pins all copies together)
+_FLEET_GUARDS = {
+    "_hosts": "_lock",
+    "_placements": "_lock",
+}
+
+
+class FleetDirectory:
+    """Registry of collector host pairs + session->pair placements.
+
+    All mutable state lives behind one asyncio lock: the directory is
+    read by the placer, the supervisor's probe loop, and status
+    producers concurrently on the leader's event loop.
+    """
+
+    def __init__(self, fleet_dir: str | None = None, obs=None):
+        self.fleet_dir = fleet_dir
+        self.obs = obs
+        self._hosts: dict = {}
+        self._placements: dict = {}
+        self._lock = asyncio.Lock()
+        guards.install(self, _FLEET_GUARDS)
+
+    # -- registration ------------------------------------------------------
+
+    async def register(self, pair: HostPair) -> None:
+        """Direct (in-process) registration; re-registering a name
+        replaces the row — a restarted pair announces its new boot ids
+        through the same door."""
+        async with self._lock:
+            pair.last_seen_s = time.time()
+            self._hosts[pair.name] = pair
+
+    async def scan(self) -> int:
+        """Fold ``<pair>_s<id>.json`` registration files (written by
+        bin/server.py under FHH_FLEET) into pair rows.  Returns the
+        number of complete pairs registered.  Torn/partial files are
+        skipped — registration writes are atomic (tmp+rename), so a
+        skip only ever means "server still booting"."""
+        if not self.fleet_dir:
+            return 0
+        halves: dict = {}
+        try:
+            names = sorted(os.listdir(self.fleet_dir))
+        except OSError:
+            return 0
+        for fn in names:
+            if not fn.endswith(".json") or "_s" not in fn:
+                continue
+            try:
+                with open(os.path.join(self.fleet_dir, fn)) as f:
+                    doc = json.load(f)
+                pair = str(doc["pair"])
+                sid = int(doc["server_id"])
+            except (OSError, ValueError, KeyError):
+                continue
+            halves.setdefault(pair, {})[sid] = doc
+        n = 0
+        async with self._lock:
+            for pair, by_id in sorted(halves.items()):
+                if 0 not in by_id or 1 not in by_id:
+                    continue
+                d0, d1 = by_id[0], by_id[1]
+                prev = self._hosts.get(pair)
+                row = HostPair(
+                    name=pair,
+                    host0=str(d0.get("host", "")), port0=int(d0.get("port", 0)),
+                    host1=str(d1.get("host", "")), port1=int(d1.get("port", 0)),
+                    boot0=str(d0.get("boot_id", "")),
+                    boot1=str(d1.get("boot_id", "")),
+                    capacity=int(d0.get("capacity", 4)),
+                    last_seen_s=time.time(),
+                )
+                if prev is not None:
+                    row.stall_fill_ratio = prev.stall_fill_ratio
+                    row.max_progress_age_s = prev.max_progress_age_s
+                self._hosts[pair] = row
+                n += 1
+        return n
+
+    # -- load signals ------------------------------------------------------
+
+    async def note_load(self, name: str, *, stall_fill_ratio: float = 0.0,
+                        max_progress_age_s: float = 0.0) -> None:
+        """Record the freshest probed load signals for a pair
+        (scheduler stall-fill ratio + the oldest session's
+        ``last_progress`` age, both straight off the pair's ``status``)."""
+        async with self._lock:
+            row = self._hosts.get(name)
+            if row is None:
+                return
+            row.stall_fill_ratio = float(stall_fill_ratio)
+            row.max_progress_age_s = float(max_progress_age_s)
+            row.last_seen_s = time.time()
+
+    async def probe(self, probe_fn) -> list:
+        """Run ``await probe_fn(pair) -> {"boot0", "boot1", ...}``
+        against every live pair.  A raised exception, or a boot id that
+        CHANGED since registration, marks the pair dead (the paper's
+        protocol cannot continue a session against a restarted secure
+        endpoint without the leader-side re-seed dance — fleet-level
+        recovery treats both the same).  Returns the names newly marked
+        dead, for the supervisor to fail their sessions over."""
+        async with self._lock:
+            live = [(p.name, p.boot0, p.boot1) for p in self._hosts.values()
+                    if p.alive]
+        died = []
+        for name, boot0, boot1 in live:
+            dead = False
+            try:
+                got = await probe_fn(name)
+            # fhh-lint: disable=broad-except (a dead host fails its
+            # probe in arbitrary ways — refused dial, timeout, torn
+            # frame; EVERY failure mode means the same thing here:
+            # mark the pair dead and fail its sessions over)
+            except Exception:
+                dead = True
+            else:
+                if boot0 and str(got.get("boot0", boot0)) != boot0:
+                    dead = True
+                if boot1 and str(got.get("boot1", boot1)) != boot1:
+                    dead = True
+            if dead:
+                died.append(name)
+        if died:
+            async with self._lock:
+                for name in died:
+                    row = self._hosts.get(name)
+                    if row is not None:
+                        row.alive = False
+            logs.emit("fleet.pairs_dead", pairs=sorted(died))
+        return died
+
+    async def mark_dead(self, name: str) -> None:
+        async with self._lock:
+            row = self._hosts.get(name)
+            if row is not None:
+                row.alive = False
+
+    # -- placement ---------------------------------------------------------
+
+    async def place(self, session: str, *, exclude: tuple = ()) -> HostPair:
+        """Pick the least-loaded live pair for ``session`` and record
+        the placement.  Load = placed/capacity, stall-fill ratio and
+        stalled-progress age breaking ties (module doc)."""
+        async with self._lock:
+            placed: dict = {}
+            for s, p in self._placements.items():
+                placed[p] = placed.get(p, 0) + 1
+            best, best_key = None, None
+            for row in self._hosts.values():
+                if not row.alive or row.name in exclude:
+                    continue
+                key = (
+                    placed.get(row.name, 0) / max(1, row.capacity),
+                    row.stall_fill_ratio,
+                    row.max_progress_age_s,
+                    row.name,
+                )
+                if best_key is None or key < best_key:
+                    best, best_key = row, key
+            if best is None:
+                raise RuntimeError("fleet: no live pair to place onto")
+            self._placements[session] = best.name
+            return best
+
+    async def placement_of(self, session: str) -> str | None:
+        async with self._lock:
+            return self._placements.get(session)
+
+    async def move(self, session: str, name: str) -> None:
+        async with self._lock:
+            self._placements[session] = name
+
+    async def release(self, session: str) -> None:
+        async with self._lock:
+            self._placements.pop(session, None)
+
+    async def orphans_of(self, name: str) -> list:
+        """Sessions placed on ``name`` (the dead pair's tenants, for the
+        supervisor to re-place)."""
+        async with self._lock:
+            return sorted(s for s, p in self._placements.items() if p == name)
+
+    async def pairs(self) -> list:
+        async with self._lock:
+            return [dataclasses.replace(p) for p in
+                    sorted(self._hosts.values(), key=lambda r: r.name)]
+
+    async def status(self) -> dict:
+        async with self._lock:
+            return {
+                "pairs": {
+                    p.name: {
+                        "alive": p.alive,
+                        "boot0": p.boot0,
+                        "boot1": p.boot1,
+                        "capacity": p.capacity,
+                        "stall_fill_ratio": round(p.stall_fill_ratio, 6),
+                        "max_progress_age_s": round(p.max_progress_age_s, 3),
+                    }
+                    for p in sorted(self._hosts.values(), key=lambda r: r.name)
+                },
+                "placements": dict(sorted(self._placements.items())),
+            }
+
+
+class FleetPlacer:
+    """Leader-side scheduler over a :class:`FleetDirectory`.
+
+    Owns the fleet observability: ``placement_decisions`` /
+    ``session_migrations`` / ``session_failovers`` counters (the
+    exporter auto-renders ``fhh_session_migrations_total``) and the
+    ``migration_inflight_since`` gauge the stuck-migration alert rule
+    watches (obs/alerts.py).  The migration/failover mechanics live in
+    ``WindowedIngest.migrate`` / ``failover_to`` — the placer decides
+    *where*, brackets the attempt for the alert rule, and keeps the
+    directory's placements truthful.
+    """
+
+    def __init__(self, directory: FleetDirectory, obs=None):
+        self.directory = directory
+        self.obs = obs if obs is not None else metrics.Registry("fleet")
+
+    async def place(self, session: str, *, exclude: tuple = ()) -> HostPair:
+        pair = await self.directory.place(session, exclude=exclude)
+        self.obs.count("placement_decisions")
+        logs.emit("fleet.placed", session=session, pair=pair.name)
+        return pair
+
+    async def migrate(self, ingest, new_lead, *, session: str,
+                      dest: str) -> dict:
+        """Live-migrate ``ingest``'s session onto ``new_lead``'s pair.
+
+        The inflight gauge stays set across the attempt so a wedged
+        transfer trips the ``migration_stuck`` alert; it is cleared on
+        BOTH outcomes (a failed migrate leaves the source authoritative
+        — see WindowedIngest.migrate's ordering guarantee)."""
+        self.obs.gauge("migration_inflight_since", time.time())
+        try:
+            stats = await ingest.migrate(new_lead)
+        finally:
+            self.obs.gauge("migration_inflight_since", 0.0)
+        self.obs.count("session_migrations")
+        self.obs.count("placement_decisions")
+        await self.directory.move(session, dest)
+        logs.emit("fleet.migrated", session=session, dest=dest, **stats)
+        return stats
+
+    async def failover(self, ingest, new_lead, *, session: str, dest: str,
+                       level: int = -1) -> dict:
+        """Recover an orphaned session (dead source pair) onto
+        ``new_lead`` from its newest banked checkpoint."""
+        self.obs.gauge("migration_inflight_since", time.time())
+        try:
+            stats = await ingest.failover_to(new_lead, level=level)
+        finally:
+            self.obs.gauge("migration_inflight_since", 0.0)
+        self.obs.count("session_failovers")
+        self.obs.count("placement_decisions")
+        await self.directory.move(session, dest)
+        logs.emit("fleet.failed_over", session=session, dest=dest, **stats)
+        return stats
+
+    async def recover_dead_pair(self, name: str, make_ingest, *,
+                                level: int = -1) -> dict:
+        """Supervisor hook: fail every session placed on dead pair
+        ``name`` over to the least-loaded survivor.  ``make_ingest``
+        maps ``(session, dest_pair) -> (ingest, new_lead)`` — the
+        caller owns connection construction (tests pass in-process
+        clients; production dials ``dest.addr(i)``)."""
+        moved = {}
+        for session in await self.directory.orphans_of(name):
+            dest = await self.place(session, exclude=(name,))
+            ingest, new_lead = await make_ingest(session, dest)
+            moved[session] = await self.failover(
+                ingest, new_lead, session=session, dest=dest.name,
+                level=level)
+        return moved
+
+    def status(self) -> dict:
+        return {
+            "placement_decisions": int(
+                self.obs.counter_value("placement_decisions")),
+            "session_migrations": int(
+                self.obs.counter_value("session_migrations")),
+            "session_failovers": int(
+                self.obs.counter_value("session_failovers")),
+            "migration_inflight_since": float(
+                self.obs.gauge_value("migration_inflight_since") or 0.0),
+        }

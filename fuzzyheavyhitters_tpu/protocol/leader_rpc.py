@@ -1330,6 +1330,135 @@ class WindowedIngest:
         if replayed:
             self.obs.count("ingest_journal_replays", replayed)
 
+    # -- fleet: live migration + whole-host failover ----------------------
+
+    async def migrate(self, new_lead: RpcLeader) -> dict:
+        """Live-migrate this session onto ``new_lead``'s host pair
+        mid-stream (protocol/fleet.py decides *where*; this is *how*).
+
+        Quiesce: the whole transfer runs under ``_submit_lock`` →
+        ``_recover_lock`` (the same order submit/seal/crawl-recovery
+        take), so no gate+mirror pair is half-landed and no boundary is
+        mid-advance; the servers additionally refuse to export mid-level.
+        Steps, ordered so a failure at ANY point leaves the source
+        authoritative (the source copy is only retired LAST):
+
+        1. ``session_export`` on both source servers (stamped blobs);
+        2. ``session_import`` on both destination servers — stamp-
+           verified, validate-before-mutate, and the destination
+           re-keys its own per-session base-OT/coin-flip plane;
+        3. rebind the driver to ``new_lead`` and replay the journal in
+           mirror form: recorded verdicts dedup everything the export
+           already carried, so every in-flight ``sub_id`` lands exactly
+           once;
+        4. re-seal the sealed windows with the ORIGINAL banked challenge
+           roots (a migrated malicious window re-opens the IDENTICAL
+           challenge — never a second opening);
+        5. bank a fresh ingest checkpoint on the destination, then
+           retire the source copy (drops its retained pools — satellite
+           of the bounded-retention contract)."""
+        async with self._submit_lock:
+            async with self._recover_lock:
+                old = self.lead
+                x0 = await old.c0.call("session_export", {})
+                x1 = await old.c1.call("session_export", {})
+                await new_lead.c0.call("session_import", {
+                    "path": x0["path"], "boot": x0["boot"],
+                    "epoch": x0["epoch"],
+                })
+                await new_lead.c1.call("session_import", {
+                    "path": x1["path"], "boot": x1["boot"],
+                    "epoch": x1["epoch"],
+                })
+                # the destination pair is now authoritative-in-waiting:
+                # rebind, then make its pools exactly-once complete
+                new_lead.has_sketch = old.has_sketch
+                for i, c in ((0, new_lead.c0), (1, new_lead.c1)):
+                    new_lead._boot_ids[i] = getattr(c, "boot_id", None)
+                self.lead = new_lead
+                replayed = sum(len(v) for v in self._journal.values())
+                await self._replay_journal(new_lead.c0, 0)
+                await self._replay_journal(new_lead.c1, 1)
+                for w in sorted(self._sealed):
+                    req = {"window": w}
+                    root = self._sealed[w].get("sk_root")
+                    if root is not None:
+                        req["sk_root"] = root
+                    await new_lead._both("window_seal", req)
+                if self._ckpt:
+                    try:
+                        await new_lead._both(
+                            "tree_checkpoint",
+                            {"level": -1, "ingest_only": True},
+                        )
+                        self._have_ckpt = True
+                    except RuntimeError as e:
+                        self._ckpt = False
+                        obsmod.emit(
+                            "ingest.checkpoint_disabled", severity="warn",
+                            error=str(e),
+                        )
+                # LAST: drop the source copy (both halves) — only after
+                # the destination holds the complete, re-sealed state
+                await old.c0.call(
+                    "session_export",
+                    {"retire": True, "epoch": x0["epoch"]},
+                )
+                await old.c1.call(
+                    "session_export",
+                    {"retire": True, "epoch": x1["epoch"]},
+                )
+                windows = [int(w) for w in x0.get("windows", [])]
+        self.obs.count("ingest_migrations")
+        obsmod.emit(
+            "ingest.session_migrated", windows=windows, replayed=replayed,
+        )
+        return {"windows": windows, "replayed": replayed}
+
+    async def failover_to(self, new_lead: RpcLeader, *,
+                          level: int = -1) -> dict:
+        """Whole-host failover: the source pair is DEAD (dead boot id on
+        probe — fleet.FleetDirectory.probe) — adopt ``new_lead``'s
+        surviving pair from this session's newest banked checkpoint in
+        the shared store.  Same machinery as :meth:`migrate` minus the
+        export/retire half: ``session_import`` of the ``level``-stamped
+        ingest blob (the one seal_window banks at every boundary), then
+        journal replay + re-seal with the original challenge roots.  A
+        session that never checkpointed still recovers: the journal
+        replay alone rebuilds every pool positionally."""
+        async with self._submit_lock:
+            async with self._recover_lock:
+                new_lead.has_sketch = self.lead.has_sketch
+                imported = False
+                for c in (new_lead.c0, new_lead.c1):
+                    if self._have_ckpt:
+                        try:
+                            await c.call("session_import", {"level": level})
+                            imported = True
+                        except RuntimeError as e:
+                            obsmod.emit(
+                                "ingest.restore_failed", severity="warn",
+                                error=str(e),
+                            )
+                for i, c in ((0, new_lead.c0), (1, new_lead.c1)):
+                    new_lead._boot_ids[i] = getattr(c, "boot_id", None)
+                self.lead = new_lead
+                replayed = sum(len(v) for v in self._journal.values())
+                await self._replay_journal(new_lead.c0, 0)
+                await self._replay_journal(new_lead.c1, 1)
+                for w in sorted(self._sealed):
+                    req = {"window": w}
+                    root = self._sealed[w].get("sk_root")
+                    if root is not None:
+                        req["sk_root"] = root
+                    await new_lead._both("window_seal", req)
+        self.obs.count("ingest_failovers")
+        obsmod.emit(
+            "ingest.session_failed_over", imported=imported,
+            replayed=replayed,
+        )
+        return {"imported": imported, "replayed": replayed}
+
 
 # ---------------------------------------------------------------------------
 # Multi-tenant: N concurrent collections against ONE server pair

@@ -1378,14 +1378,19 @@ class CollectorServer:
     # collection has its own token bucket, quotas, and reservoir, so a
     # flooding tenant exhausts only its own gate. --------------------------
 
-    async def submit_keys(self, req, cs: CollectionSession | None = None) -> dict:  # fhh-race: atomic (unlocked ingest fast path: never suspends, so admission+append is one event-loop slice; rides concurrently with a crawl HOLDING the verb lock — that concurrency is the front door's whole point)
+    async def submit_keys(self, req, cs: CollectionSession | None = None) -> dict:
         """Streaming key submission into the named window's pool —
         admission-controlled, append-only, idempotent per ``sub_id``.
 
-        Dispatches WITHOUT the verb lock (like ``add_keys``: no awaits,
-        so it is atomic on the event loop) — ingest rides concurrently
-        with a crawl holding the lock, which is what lets a window
-        accrue while the previous window's frozen snapshot is crawled.
+        Dispatches WITHOUT the verb lock (like ``add_keys``) — ingest
+        rides concurrently with a crawl holding the lock, which is what
+        lets a window accrue while the previous window's frozen snapshot
+        is crawled.  The admission arithmetic (token bucket, quotas,
+        reservoir draws) runs in an EXECUTOR behind the session's
+        ``_adm_gate``, so a flooding tenant's admission math cannot
+        stall the shared event loop; the one suspension point
+        re-validates the dup/seal state before any pool mutates (the
+        append itself never suspends).
 
         Req: ``{window, sub_id, client_id, keys: chunk}`` plus an
         optional ``mirror`` dict carrying the GATE server's verdict —
@@ -1438,13 +1443,28 @@ class CollectorServer:
             )
         mirror = req.get("mirror")
         if mirror is not None:
+            # mirror replay never suspends: the gate server's verdict is
+            # applied positionally, so the two pools stay identical
             resp = pool.apply_mirror(
                 sub_id, chunk, mirror, str(req.get("client_id", ""))
             )
         else:
-            v = cs._admission.admit(
-                pool.wa, str(req.get("client_id", "")), n_keys
+            v = await cs._admission.admit_offloaded(
+                pool.wa, str(req.get("client_id", "")), n_keys,
+                gate=cs._adm_gate,
             )
+            # the executor await suspended this task: another frame may
+            # have replayed this sub_id or sealed the window meanwhile —
+            # re-validate before the (non-suspending) append mutates
+            prev = pool.verdicts.get(sub_id)
+            if prev is not None:
+                cs.obs.count("pool_dup_submits")
+                return dict(prev, dup=True)
+            if pool.sealed:
+                raise RuntimeError(
+                    f"ingest window {window} sealed during admission — "
+                    "submit into a later window"
+                )
             resp = pool.apply(sub_id, chunk, v)
         if resp.get("admitted"):
             cs.obs.count("pool_admitted_keys", n_keys)
@@ -1572,6 +1592,221 @@ class CollectorServer:
             "sketch": has_sketch,
         }
 
+    # -- fleet migration verbs (protocol/fleet.py: live session
+    # placement across host pairs) ----------------------------------------
+
+    async def session_export(self, req, cs: CollectionSession | None = None) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the session's verb lock; sanitizer-validated)
+        """Bank this session's migratable state as a stamped blob for a
+        ``session_import`` on another host pair, or — ``retire`` mode —
+        drop the source copy after a confirmed transfer.
+
+        Export quiesces at a window/level boundary only: a session with
+        mid-level crawl caches (children banked between crawl and prune,
+        sharded spans in flight) refuses loudly — migrating a torn level
+        would strand half an exchange on each pair.  The blob is the
+        ingest form of the PR-4/7 checkpoint (pools, per-``sub_id``
+        verdicts, quota ledgers, reservoir RNG state, and each sealed
+        window's committed challenge root), stamped with this server's
+        boot id and a per-session export epoch so the importer can
+        refuse a replayed or double-applied transfer.  Crawl state is
+        NOT exported: windowed crawls rebuild it from the pools
+        (``window_load``), and the destination pair re-keys its own
+        base-OT/coin-flip plane — per-window challenge roots ride the
+        pools, so a migrated malicious window still replays the
+        IDENTICAL challenge.
+
+        Retire mode (``{"retire": True, "epoch": E}``): called after the
+        destination confirmed its import — drops every retained window
+        pool (sealed ones included: bounded retention only evicts on
+        idle, and a migrated-away tenant would otherwise pin its pools
+        on this host forever) and the crawl state, leaving the session
+        idle-evictable."""
+        cs = cs if cs is not None else self._default()
+        req = req or {}
+        if req.get("retire"):
+            epoch = int(req.get("epoch", -1))
+            if epoch <= 0 or epoch != cs._export_epoch:
+                raise RuntimeError(
+                    f"session_export: retire epoch {epoch} does not match "
+                    f"the last export ({cs._export_epoch}) — refusing to "
+                    "drop state that was never transferred"
+                )
+            dropped = len(cs._ingest_pools)
+            cs._ingest_pools.clear()
+            cs.clear_crawl_state()
+            cs.keys = None
+            cs.keys_parts.clear()
+            cs.alive_keys = None
+            if cs.ckpt_dir is not None and os.path.exists(self._export_path(cs)):
+                os.remove(self._export_path(cs))
+            # the migrated-away tenant must not hold this pair's
+            # progress-age placement signal high forever
+            self._sched.forget(cs.key)
+            cs.obs.count("sessions_retired")
+            obs.emit(
+                "fleet.session_retired",
+                server=self.server_id,
+                collection=cs.key,
+                pools_dropped=dropped,
+            )
+            return {"retired": True, "pools_dropped": dropped}
+        # mid-level = in-flight expand caches (children banked between
+        # crawl and prune, sharded spans mid-assembly).  _last_shares
+        # alone is NOT mid-level: it lingers after a COMPLETED crawl
+        # (final_shares re-serves it) and the destination rebuilds crawl
+        # state from the pools via window_load anyway.
+        if (cs._children is not None or cs._shard_children
+                or cs._shard_last):
+            raise RuntimeError(
+                "session_export: session is mid-level — a migration "
+                "quiesces at a window/level boundary only"
+            )
+        if cs.ckpt_dir is None:
+            raise RuntimeError(
+                "session_export: no checkpoint dir configured "
+                "(start the server with FHH_CKPT_DIR set)"
+            )
+        blob = {
+            "ing_only": np.bool_(True),
+            "sess": np.str_(cs.key),
+            "level": np.int64(-1),
+        }
+        cs.ingest_ckpt_fields(blob)
+        cs._export_epoch += 1
+        blob["xp_boot"] = np.str_(self._boot_id)
+        blob["xp_epoch"] = np.int64(cs._export_epoch)
+        path = self._export_path(cs)
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(f, **blob)
+        os.replace(tmp, path)
+        cs.obs.count("session_exports")
+        obs.emit(
+            "fleet.session_exported",
+            server=self.server_id,
+            collection=cs.key,
+            epoch=cs._export_epoch,
+            windows=sorted(cs._ingest_pools),
+            path=path,
+        )
+        return {
+            "path": path,
+            "boot": self._boot_id,
+            "epoch": cs._export_epoch,
+            "windows": sorted(cs._ingest_pools),
+        }
+
+    def _export_path(self, cs: CollectionSession) -> str:
+        # inside the session's checkpoint namespace but OUTSIDE the
+        # level-stamp grammar ("xport" never parses as an int), so
+        # ckpt_levels/ckpt_prune ignore it
+        return os.path.join(cs.ckpt_dir, f"{cs.ckpt_prefix()}xport.npz")
+
+    async def session_import(self, req, cs: CollectionSession | None = None) -> dict:  # fhh-race: holds=_verb_lock (dispatched only by _dispatch, which holds the session's verb lock; sanitizer-validated)
+        """Adopt a migrated (or orphaned) session's banked state.
+
+        Two sources: ``{"path", "boot", "epoch"}`` names a
+        ``session_export`` blob (live migration — the stamps must match
+        the announced export, and a (boot, epoch) pair imports at most
+        ONCE: double-applying a transfer would double-land its in-flight
+        ``sub_id`` replays); ``{"level": N}`` names this session's own
+        checkpoint-namespace blob (whole-host failover — the orphan's
+        newest ingest checkpoint in the shared store, no stamps).
+
+        Validate-before-mutate: a torn/corrupt blob, a wrong-collection
+        stamp, a replayed stamp, or a torn ``ing_*`` tail refuses with
+        the live state of BOTH hosts untouched.  Only ingest-form blobs
+        import — crawl state rebuilds from the pools via ``window_load``,
+        and the per-session secure plane is force re-keyed (fresh
+        coin flip + base-OT against THIS pair's peer at the next
+        data-plane verb), never carried across hosts."""
+        cs = cs if cs is not None else self._default()
+        req = req or {}
+        if cs.ckpt_dir is None:
+            raise RuntimeError("session_import: no checkpoint dir configured")
+        stamp = None
+        if req.get("path") is not None:
+            path = str(req["path"])
+            stamp = (str(req.get("boot", "")), int(req.get("epoch", 0)))
+        else:
+            path = cs.ckpt_path(int(req["level"]))
+        if not os.path.exists(path):
+            raise RuntimeError(f"session_import: no blob at {path}")
+        try:
+            with np.load(path) as npz:
+                z = {k: npz[k] for k in npz.files}
+        # np.load surfaces torn/partial writes as BadZipFile/ValueError/
+        # EOFError depending on where the file was cut (same boundary as
+        # tree_restore)
+        except Exception as e:  # fhh-lint: disable=broad-except (corrupt-blob classification: every load failure maps to the same loud refusal; state is untouched)
+            raise RuntimeError(
+                f"session_import: corrupt or truncated blob at {path} "
+                f"({type(e).__name__}: {e})"
+            ) from e
+        if "sess" in z and str(z["sess"]) != cs.key:
+            raise RuntimeError(
+                f"session_import: blob at {path} is stamped for "
+                f"collection {str(z['sess'])!r}, not {cs.key!r}"
+            )
+        if stamp is not None:
+            got = (str(z.get("xp_boot", "")), int(z.get("xp_epoch", 0)))
+            if got != stamp:
+                raise RuntimeError(
+                    f"session_import: blob at {path} carries stamp {got}, "
+                    f"not the announced export {stamp} (stale file?)"
+                )
+            if stamp in cs._import_seen:
+                raise RuntimeError(
+                    f"session_import: export {stamp} was already imported "
+                    "— double-applying a transfer would double-land its "
+                    "in-flight submissions"
+                )
+        if not ("ing_only" in z and bool(z["ing_only"])):
+            raise RuntimeError(
+                "session_import: only ingest-form blobs migrate (crawl "
+                "state rebuilds from the pools via window_load); use "
+                "add_keys + tree_restore for a crawl-level checkpoint"
+            )
+        # validate the whole ing_* tail BEFORE any state mutates
+        parsed = cs.ingest_validate(z, path)
+        # -- all checks passed: mutate ------------------------------------
+        if parsed is not None:
+            cs.ingest_restore_apply(parsed)
+            if taint_guard.enabled():
+                # the reconstructed pool entries are the clients' key
+                # SHARES (and in malicious mode their sketch material):
+                # secret bytes this host never saw before the import —
+                # register them so the obs sinks keep refusing them
+                for pool in cs._ingest_pools.values():
+                    for entry in pool.entries:
+                        for leaf in entry:
+                            taint_guard.register(
+                                "CollectionSession._imported_pool_shares",
+                                leaf,
+                            )
+        else:
+            cs._ingest_pools.clear()  # an empty export imports as empty
+        if stamp is not None:
+            cs._import_seen.add(stamp)
+        # force a fresh per-session plane handshake against THIS pair's
+        # peer: OT endpoints and the coin flip never migrate across
+        # hosts (epoch 0 = never keyed — _ensure_session_plane re-keys
+        # lazily at the next data-plane verb)
+        cs._ot = None
+        cs._ot_snd = None
+        cs._ot_rcv = None
+        cs._sec_seed = None
+        cs.plane_epoch = 0
+        cs.obs.count("session_imports")
+        obs.emit(
+            "fleet.session_imported",
+            server=self.server_id,
+            collection=cs.key,
+            windows=sorted(cs._ingest_pools),
+            path=path,
+        )
+        return {"windows": sorted(cs._ingest_pools)}
+
     # -- resilience verbs (no reference analogue: the reference's only
     # recovery verb is reset, server.rs:64-69) ---------------------------
 
@@ -1623,6 +1858,22 @@ class CollectorServer:
             "mesh": self._mesh_status(cs),
             # multi-tenant rollup (sessions.SessionTable + tenancy)
             "sessions": sess,
+            # fleet identity + migration accounting (protocol/fleet.py):
+            # which registered pair this server is half of, and how many
+            # sessions moved through it
+            "fleet": {
+                "pair": os.environ.get("FHH_FLEET_PAIR", ""),
+                "boot_id": self._boot_id,
+                "session_exports": int(
+                    cs.obs.counter_value("session_exports")
+                ),
+                "session_imports": int(
+                    cs.obs.counter_value("session_imports")
+                ),
+                # placement signals in the shape FleetDirectory.note_load
+                # consumes — the supervisor's probe loop forwards them
+                "load": self._sched.fleet_load(),
+            },
             # live SLO quantiles (obs.hist): per-level crawl latency,
             # per-verb RPC latency, seal-to-hitters — p50/p95/p99 from
             # the calling session's fixed-bucket histograms
@@ -2330,6 +2581,9 @@ class CollectorServer:
         "plane_reset",
         "plane_break",  # pipelined-crawl quiesce (unlocked dispatch)
         "warmup",  # per-f_bucket compile warmup (no protocol state)
+        # fleet migration/failover (protocol/fleet.py)
+        "session_export",
+        "session_import",
     )
 
     # verbs that run under the SERVER infra lock instead of the calling
@@ -2436,19 +2690,21 @@ class CollectorServer:
                 )
                 with span_ctx:
                     if verb in ("add_keys", "submit_keys", "plane_break"):
-                        # add_keys/submit_keys: append-only, no awaits ->
-                        # atomic; submit_keys MUST bypass the lock so
-                        # ingest keeps flowing while a windowed crawl
-                        # holds it (that concurrency is the whole point
-                        # of the front door).  plane_break MUST bypass
-                        # it too: it exists to break a verb wedged on
-                        # the data plane while HOLDING the lock
-                        # (pipelined quiesce) — behind the lock it could
-                        # never run.
+                        # add_keys: append-only, no awaits -> atomic;
+                        # submit_keys MUST bypass the lock so ingest
+                        # keeps flowing while a windowed crawl holds it
+                        # (that concurrency is the whole point of the
+                        # front door) — its one suspension (executor
+                        # admission) re-validates before mutating.
+                        # plane_break MUST bypass it too: it exists to
+                        # break a verb wedged on the data plane while
+                        # HOLDING the lock (pipelined quiesce) — behind
+                        # the lock it could never run.
                         with guards.unguarded(
-                            "unlocked fast-path verb: event-loop-atomic "
-                            "by the fhh-race atomic contracts on "
-                            "add_keys/submit_keys"
+                            "unlocked fast-path verb: add_keys is "
+                            "event-loop-atomic by its fhh-race contract; "
+                            "submit_keys re-validates after its one "
+                            "suspension (executor admission)"
                         ):
                             resp = await getattr(self, verb)(req, cs)
                     elif verb in self._SERVER_VERBS:

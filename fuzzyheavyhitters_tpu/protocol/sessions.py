@@ -284,6 +284,8 @@ _SESSION_GUARDS = {
     "_sketch_root": "_verb_lock",
     "_ratchet_digest": "_verb_lock",
     "_window_sketch_root": "_verb_lock",
+    "_export_epoch": "_verb_lock",
+    "_import_seen": "_verb_lock",
 }
 
 
@@ -371,6 +373,17 @@ class CollectionSession:
             shed=cfg.ingest_shed,
             seed=cfg.ingest_seed,
         )
+        # admission gate: submit_keys runs the token-bucket arithmetic
+        # in an executor behind this per-session lock, so a flooding
+        # tenant's admission math never stalls the shared event loop
+        # while the bucket still mutates strictly serialized per session
+        self._adm_gate = asyncio.Lock()
+        # -- fleet migration bookkeeping (protocol/fleet.py) ---------------
+        # session_export stamps each blob with (boot id, export epoch);
+        # session_import refuses a replayed stamp — double-importing one
+        # export would double-land its in-flight sub_ids
+        self._export_epoch = 0
+        self._import_seen: set = set()
         # -- multi-chip mesh: per-session binding over the shared devices --
         # (ServerMesh.bind pins shard count to the client batch, which is
         # per-collection state; the underlying Mesh + jitted reduction

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import time
 
 from ..obs import trace as obstrace
 
@@ -52,6 +53,10 @@ class TenantScheduler:
         self.stall_fills = 0
         self.turns_by_session: dict[str, int] = {}
         self.fills_by_session: dict[str, int] = {}
+        # session key -> wall-clock of its last device turn: the age of
+        # the OLDEST entry is the "is anything starving here" placement
+        # signal the fleet layer reads (FleetDirectory.note_load)
+        self.last_progress_s: dict[str, float] = {}
 
     # -- accounting primitives --------------------------------------------
 
@@ -61,6 +66,7 @@ class TenantScheduler:
     def _note_turn(self, key: str) -> None:
         self.device_turns += 1
         self.turns_by_session[key] = self.turns_by_session.get(key, 0) + 1
+        self.last_progress_s[key] = time.time()
         if self.obs is not None:
             self.obs.count("tenant_device_turns")
         if self._others_on_wire(key):
@@ -114,6 +120,29 @@ class TenantScheduler:
 
     def wire_waiting(self) -> list:
         return sorted(k for k, n in self._wire.items() if n > 0)
+
+    def forget(self, key: str) -> None:
+        """Drop one session's accounting rows (retire / migration away):
+        a dead tenant must not hold the pair's progress-age signal high
+        forever."""
+        self.turns_by_session.pop(key, None)
+        self.fills_by_session.pop(key, None)
+        self.last_progress_s.pop(key, None)
+
+    def fleet_load(self, now: float | None = None) -> dict:
+        """The pair-half's placement signals, in exactly the shape
+        :meth:`FleetDirectory.note_load` consumes: the stall-fill ratio
+        (how contended this accelerator is) and the age of the
+        least-recently-progressing session (is anything starving)."""
+        if now is None:
+            now = time.time()
+        ages = [now - t for t in self.last_progress_s.values()]
+        return {
+            "stall_fill_ratio": round(
+                self.stall_fills / max(1, self.device_turns), 6
+            ),
+            "max_progress_age_s": round(max(ages, default=0.0), 3),
+        }
 
     def stats(self) -> dict:
         return {

@@ -38,6 +38,7 @@ rejection.
 
 from __future__ import annotations
 
+import asyncio
 import time
 from dataclasses import dataclass, field
 
@@ -280,3 +281,20 @@ class AdmissionController:
                     retry_after_s=self.bucket.wait_s(n_keys),
                 )
         return wa.decide(client_id, n_keys)
+
+    async def admit_offloaded(self, wa: WindowAdmission, client_id: str,
+                              n_keys: int, *, gate: asyncio.Lock) -> Verdict:
+        """:meth:`admit` off the shared event loop: the bucket/quota/
+        reservoir arithmetic runs in the default executor behind the
+        caller's per-session ``gate``, so a flooding tenant's admission
+        math occupies a worker thread, not the server loop — other
+        tenants' verbs (and other sessions' admissions) keep
+        interleaving.  The gate serializes decisions PER SESSION: the
+        determinism contract (module doc) is about the decision
+        SEQUENCE, and two interleaved executor runs against one window's
+        ledgers would fork it."""
+        async with gate:
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(
+                None, self.admit, wa, client_id, n_keys
+            )

@@ -424,6 +424,102 @@ class MeshChaos:
             )
 
 
+# ---------------------------------------------------------------------------
+# Host chaos: whole-collector-pair loss (the fleet failover drill)
+#
+# Above the connection layer (ChaosProxy severs one link) and the device
+# layer (MeshChaos clobbers one participant) sits the host: BOTH servers
+# of a collector pair vanishing at once — a rack power loss, a preempted
+# VM pair.  The surrogate is driven by the windowed ingest driver at its
+# window boundaries (the same place the mesh injector uses level
+# boundaries): a clause whose ``at_window`` has been reached fires once,
+# and the harness kills the whole pair — the supervisor's probe then
+# sees dead boot ids and fails the orphaned sessions over to a surviving
+# pair (protocol/fleet.py) from their newest checkpoints.
+#
+# Grammar (``FHH_HOST_FAULTS``): ``host:kill@window=<N>``, ';'-separated,
+# consumed once each like the mesh clauses.
+# ---------------------------------------------------------------------------
+
+_HOST_ACTIONS = ("kill",)
+
+
+@dataclass(frozen=True)
+class HostFaultSpec:
+    action: str
+    at_window: int
+
+    def __post_init__(self):
+        if self.action not in _HOST_ACTIONS:
+            raise ValueError(f"unknown host chaos action {self.action!r}")
+        if self.at_window < 0:
+            raise ValueError("window= trigger must be >= 0")
+
+
+def parse_host_faults(spec: str) -> list:
+    """Parse an ``FHH_HOST_FAULTS`` spec (grammar above).  Blank specs
+    parse to no faults; malformed clauses raise ValueError loudly, same
+    contract as :func:`parse_faults`/:func:`parse_mesh_faults`."""
+    out: list[HostFaultSpec] = []
+    for clause in (spec or "").split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        try:
+            head, args = clause.split("@", 1)
+            link, action = head.split(":", 1)
+        except ValueError:
+            raise ValueError(
+                f"bad host chaos clause {clause!r} "
+                "(want host:kill@window=N)"
+            ) from None
+        if link.strip() != "host":
+            raise ValueError(f"host chaos clause {clause!r} must target 'host'")
+        kw: dict = {}
+        for part in args.split(","):
+            k, _, v = part.partition("=")
+            k, v = k.strip(), v.strip()
+            if k == "window":
+                kw["at_window"] = int(v)
+            else:
+                raise ValueError(f"unknown host chaos arg {k!r} in {clause!r}")
+        if "at_window" not in kw:
+            raise ValueError(f"host chaos clause {clause!r} missing window=")
+        out.append(HostFaultSpec(action=action.strip(), **kw))
+    return out
+
+
+class HostChaos:
+    """Consumed-once host-pair fault schedule.  ``before_window(w)``
+    returns True when a clause fires for this boundary — the caller
+    (test harness / supervisor drill) then kills the whole pair; the
+    injector itself stays process-agnostic because "a host" may be two
+    in-process servers (tests) or two real processes (bin/server)."""
+
+    def __init__(self, faults: list | None = None):
+        self._armed: list[HostFaultSpec] = list(faults or [])
+        self.fired: list[tuple[str, int]] = []  # (action, window)
+
+    def before_window(self, window: int) -> bool:
+        hit = False
+        for f in list(self._armed):
+            if window < f.at_window:
+                continue
+            self._armed.remove(f)
+            self.fired.append((f.action, window))
+            obs.emit(
+                "resilience.host_chaos_fired",
+                severity="debug",
+                action=f.action,
+                window=window,
+            )
+            obs.trace.instant(
+                f"chaos.host_{f.action}", comp="chaos:host", level=window,
+            )
+            hit = True
+        return hit
+
+
 @dataclass
 class ChaosLinks:
     """Convenience bundle for the standard three-link topology: leader→s0,

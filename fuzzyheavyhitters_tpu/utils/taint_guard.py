@@ -62,6 +62,8 @@ _DEFAULT_SOURCES = {
     "OtExtSender.s_bits": "ops/otext.py OtExtSender.__init__",
     "OtExtReceiver._seeds0": "ops/otext.py OtExtReceiver.__init__",
     "OtExtReceiver._seeds1": "ops/otext.py OtExtReceiver.__init__",
+    "CollectionSession._imported_pool_shares":
+        "rpc session_import pool reconstruction",
 }
 
 # True once ANY source registered in this process: the sink-boundary

@@ -16,7 +16,10 @@
 #     malicious-sketch suite (tests/test_sketch_shard.py — the sharded
 #     verify bit-identity matrix and the WINDOWED-MALICIOUS recovery
 #     leg: kill/restart mid-window, the re-run replaying the identical
-#     committed challenge root),
+#     committed challenge root), AND the collector-fleet suite
+#     (tests/test_fleet.py — live session migration, whole-host
+#     host:kill failover: tenant A floods while the whole pair dies
+#     mid-crawl of tenant B, B resumes bit-identical on the survivor),
 #     INCLUDING the slow-marked multi-fault storm tier-1 skips
 #   - writes a JSON artifact ({passed, failed, duration_s, tests}) to $1
 #     (default: chaos_report.json); exits non-zero on any failure
@@ -35,6 +38,7 @@ report="$(mktemp)"
 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_resilience.py tests/test_mesh_chaos.py tests/test_ingest.py \
     tests/test_multichip.py tests/test_sessions.py tests/test_sketch_shard.py \
+    tests/test_fleet.py \
     -m "" -q \
     -p no:cacheprovider --junitxml="$report"
 rc=$?
@@ -48,6 +52,7 @@ rc=$?
 JAX_PLATFORMS=cpu FHH_DEBUG_GUARDS=1 python -m pytest \
     "tests/test_resilience.py::test_e2e_chaos_recovery_bit_identical" \
     "tests/test_sessions.py::test_tenant_isolation_flood_and_kill_restart_mid_crawl" \
+    "tests/test_fleet.py::test_host_kill_mid_crawl_under_flood_tenant_b_bit_identical" \
     -q -p no:cacheprovider
 guards_rc=$?
 if [ $guards_rc -ne 0 ]; then
@@ -64,6 +69,7 @@ fi
 JAX_PLATFORMS=cpu FHH_DEBUG_TAINT=1 python -m pytest \
     "tests/test_resilience.py::test_e2e_chaos_recovery_bit_identical" \
     "tests/test_sessions.py::test_tenant_isolation_flood_and_kill_restart_mid_crawl" \
+    "tests/test_fleet.py::test_host_kill_mid_crawl_under_flood_tenant_b_bit_identical" \
     -q -p no:cacheprovider
 taint_rc=$?
 if [ $taint_rc -ne 0 ]; then
@@ -116,6 +122,14 @@ doc = {
     "debug_guards": "passed" if sys.argv[3] == "0" else "failed",
     "trace_validation": "passed" if sys.argv[4] == "0" else "failed",
     "debug_taint": "passed" if sys.argv[5] == "0" else "failed",
+    # the collector-fleet legs (migration + host:kill failover), folded
+    # out of the main run so fleet health is one key deep
+    "fleet": {
+        t["name"].split("::")[-1]: t["outcome"]
+        for t in tests
+        if "test_fleet" in t["name"]
+        and ("migration" in t["name"] or "host_kill" in t["name"])
+    },
     "tests": tests,
 }
 json.dump(doc, open(sys.argv[2], "w"), indent=1)

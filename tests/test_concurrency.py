@@ -462,6 +462,7 @@ def test_guard_map_drift_pyproject_vs_runtime_twins():
     test), and the runtime twin tables the sanitizer arms.  This pins
     pyproject == runtime twins, so an attribute guarded statically is
     exactly the set asserted dynamically."""
+    from fuzzyheavyhitters_tpu.protocol import fleet as fleetmod
     from fuzzyheavyhitters_tpu.protocol import sessions as sessmod
 
     cfg = load_config(REPO)
@@ -475,6 +476,10 @@ def test_guard_map_drift_pyproject_vs_runtime_twins():
     want.update({
         f"WindowedIngest.{a}": lk
         for a, lk in leader_rpc._INGEST_GUARDS.items()
+    })
+    want.update({
+        f"FleetDirectory.{a}": lk
+        for a, lk in fleetmod._FLEET_GUARDS.items()
     })
     assert cfg.guards == want
 

@@ -140,7 +140,14 @@ def test_largest_divisor_shard_binding():
 
 @pytest.mark.parametrize(
     "mode",
-    ["trusted", "secure_ot2s", "secure_gc", "sketch"],
+    [
+        "trusted",
+        "secure_ot2s",
+        "secure_gc",
+        # ~40 s on one core; sketch sharding parity is also covered
+        # by test_sketch_shard — tier-1 keeps the other three modes
+        pytest.param("sketch", marks=pytest.mark.slow),
+    ],
 )
 def test_sharded_vs_single_device_bit_identical(mode, client_keys,
                                                 sketch_keys):
@@ -331,6 +338,8 @@ def test_kernel_sharded_crawl_bit_identical_with_device_kill(kernel_keys):
     assert sk["otext_seconds"] > 0 and sk["b2a_seconds"] > 0
 
 
+@pytest.mark.slow  # ~27 s: same warm-ladder contract as the
+# multichip/malicious warmed tests that stay in tier-1
 def test_warmed_kernel_sharded_crawl_zero_fresh_compiles(kernel_keys):
     """The warmup contract extends to the ROW-SHARDED kernel ladder:
     after one warmed kernel-sharded secure crawl, a second identically-
