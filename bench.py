@@ -817,6 +817,122 @@ def bench_secure(n=1024, L=12, port=21831, shard_nodes=4, pipeline_depth=4):
     }
 
 
+def bench_radix(n=1024, L=12, port=23431, radices=(1, 2, 3)):
+    """Radix-2^k level fusion sweep (``Config.crawl_radix_bits``): the
+    same secure crawl at k = 1, 2, 3 bits per round trip, each k on its
+    own warmed server pair.  The fused rounds widen the equality strings
+    to S' = 2k (ot2s at this 1-dim shape) and cut the crawl to
+    ceil(L/k) round trips — the win is the per-round fixed cost
+    (control-plane verbs, device<->host fetches, OT/GC handshakes) paid
+    ceil(L/k) times instead of L.
+
+    Identity gate first, numbers second: every k's heavy-hitter counts
+    AND paths are asserted bit-identical to the k=1 run before anything
+    is reported, and the per-server ``rpc:{verb}`` histograms must show
+    exactly ceil(L/k) crawl verbs — a sweep that cheated on either
+    contract reports nothing.  Timings exclude compiles (per-radix
+    warmup ladder + FHH_COMPILE_CACHE, same policy as bench_secure).
+
+    NB: over loopback a round trip costs ~0, while the fused ot2s
+    tables grow 4^k rows per dim — so smoke shapes legitimately report
+    ``speedup_vs_k1`` < 1.  The fusion wins where the tentpole aims:
+    real inter-site tunnels whose per-round fixed cost (RTT + the ~3
+    serial device<->host fetches bench_secure documents) dwarfs the
+    wider table, where cutting L rounds to ceil(L/k) is the headline."""
+    import asyncio
+    import dataclasses
+    import math
+
+    from fuzzyheavyhitters_tpu.ops import ibdcf
+    from fuzzyheavyhitters_tpu.utils.config import Config
+
+    rng = np.random.default_rng(3)
+    sites = rng.integers(0, 1 << L, size=8)
+    pts = sites[rng.integers(0, 8, size=n)]
+    pts_bits = (
+        ((pts[:, None, None] >> np.arange(L - 1, -1, -1)) & 1) > 0
+    )  # [n, 1, L] MSB-first
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng, engine=_keygen_engine())
+
+    base_cfg = Config(
+        data_len=L, n_dims=1, ball_size=2, addkey_batch_size=1024,
+        num_sites=8, threshold=0.05, zipf_exponent=1.03,
+        server0=f"127.0.0.1:{port}", server1=f"127.0.0.1:{port + 10}",
+        distribution="zipf", f_max=64, secure_exchange=True,
+    )
+
+    def crawl_verbs(server):
+        hists = server._default().obs.report()["hists"]
+        return sum(
+            hists[v]["count"]
+            for v in ("rpc:tree_crawl", "rpc:tree_crawl_last")
+            if v in hists
+        )
+
+    async def leg(k, leg_port):
+        cfg = dataclasses.replace(
+            base_cfg,
+            crawl_radix_bits=k,
+            server0=f"127.0.0.1:{leg_port}",
+            server1=f"127.0.0.1:{leg_port + 10}",
+        )
+        lead, c0, c1, s0, s1 = await _bring_up_pair(cfg, leg_port)
+        await lead.upload_keys(k0, k1)
+        await lead.warmup()  # per-radix program ladder, off the clock
+        await lead.run(n)  # warm: residual compile/trace cost
+        # reset clears the warm run's verb accounting, so the histograms
+        # below count the TIMED crawl's round trips alone
+        await asyncio.gather(c0.call("reset"), c1.call("reset"))
+        await lead.upload_keys(k0, k1)
+        t = time.perf_counter()
+        res = await lead.run(n)
+        dt = time.perf_counter() - t
+        verbs = (crawl_verbs(s0), crawl_verbs(s1))
+        for c in (c0, c1):
+            await c.aclose()
+        for s in (s0, s1):
+            await s.aclose()
+        return res, dt, verbs
+
+    async def run():
+        out = {}
+        for i, k in enumerate(radices):
+            out[k] = await leg(k, port + 40 * i)
+        return out
+
+    legs = asyncio.run(run())
+    base_res, base_dt, _ = legs[1]
+    assert base_res.paths.shape[0] >= 1
+    rounds_want = {k: math.ceil(L / k) for k in radices}
+    sweep = {}
+    for k, (res, dt, verbs) in legs.items():
+        # the identity gate: a fused crawl that drifted from the k=1
+        # sets/paths — or issued more round trips than it claims —
+        # reports NOTHING
+        assert np.array_equal(base_res.counts, res.counts), k
+        assert np.array_equal(base_res.paths, res.paths), k
+        assert verbs == (rounds_want[k], rounds_want[k]), (k, verbs)
+        sweep[k] = {
+            "crawl_seconds": round(dt, 3),
+            "clients_per_sec": round(n / dt, 1),
+            "round_trips": rounds_want[k],
+            "ms_per_round_trip": round(dt / rounds_want[k] * 1000, 2),
+            "speedup_vs_k1": round(base_dt / dt, 2),
+        }
+    best_k = min(legs, key=lambda k: legs[k][1])
+    return {
+        "n_clients": n,
+        "data_len": L,
+        "radix_sweep": {str(k): v for k, v in sweep.items()},
+        "best_k": int(best_k),
+        # bit levels crawled per round trip at the best k — the fused
+        # crawl's level rate multiplier over one-bit-per-round
+        "level_rate_x_k": round(L / rounds_want[best_k], 2),
+        "speedup_vs_k1": sweep[best_k]["speedup_vs_k1"],
+        "bit_identical": True,
+    }
+
+
 def bench_multichip(n=1024, L=12, port=22231, shards=(1, 2, 4, 8),
                     f_max=64, kernel_shards=(1, 2, 4, 8)):
     """Multi-chip collector servers: secure clients/sec as each server's
@@ -2174,6 +2290,9 @@ _COMPACT_KEYS = {
         "semi_honest_clients_per_sec", "bit_identical", "sketch_shards",
         "verify_seconds",
     ),
+    "radix": (
+        "level_rate_x_k", "speedup_vs_k1", "best_k", "bit_identical",
+    ),
 }
 
 
@@ -2341,6 +2460,17 @@ def main(argv=None):
             " pipeline_depth=3)))"
         ),
     )
+    radix = section(
+        "radix",
+        "import json, bench;print(json.dumps(bench.bench_radix()))",
+        # three warmed secure pairs (k = 1, 2, 3), each with its own
+        # fused-shape warmup ladder; later runs hit FHH_COMPILE_CACHE
+        timeout_s=900,
+        smoke_code=(
+            "import json, bench;"
+            "print(json.dumps(bench.bench_radix(n=64, L=6)))"
+        ),
+    )
     multichip = section(
         "multichip",
         "import json, bench;print(json.dumps(bench.bench_multichip()))",
@@ -2457,6 +2587,7 @@ def main(argv=None):
         "crawl": crawl,
         "crawl_hbm_max": crawl_hbm_max,
         "secure_crawl": secure,
+        "radix": radix,
         "multichip": multichip,
         "sketch": sketch,
         "secure_device": secure_device,

@@ -55,6 +55,9 @@ _DEFAULT_GUARDS = {
     # dispatch under the session's _verb_lock)
     "CollectionSession._export_epoch": "_verb_lock",
     "CollectionSession._import_seen": "_verb_lock",
+    # radix-2^k level fusion: the session's fused-bits-per-verb knob
+    # (fixed at construction, read by every crawl verb under the lock)
+    "CollectionSession._radix": "_verb_lock",
     # CollectorServer infra: the replay-dedup session table
     "CollectorServer._sessions": "_verb_lock",
     # WindowedIngest: gate-order == mirror-order state serializes on

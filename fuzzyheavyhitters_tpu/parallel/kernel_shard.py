@@ -155,18 +155,20 @@ def bind(devices: tuple, B: int, S: int, budget: int) -> KernelShard | None:
 
 
 @lru_cache(maxsize=None)
-def _flat_fn(d: int, F: int, N: int, bp: int):
-    """packed u32[F, N] -> zero-padded flat strings bool[bp, 2d] (the
-    whole-level test order (F, C, N), the planar frame extent)."""
+def _flat_fn(d: int, F: int, N: int, bp: int, radix: int = 1):
+    """packed u32[F, N] -> zero-padded flat strings bool[bp, 2*d*radix]
+    (the whole-level test order (F, C, N), the planar frame extent).
+    radix > 1 reads the fused radix layout: C = 2^(radix*d) children per
+    frontier node, string width S' = 2*d*radix."""
     from ..protocol import secure
 
     def f(packed):
-        strs = secure.child_strings(packed, d)  # [F, C, N, S]
-        B = F * (1 << d) * N
-        flat = strs.reshape(B, 2 * d)
+        strs = secure.child_strings_radix(packed, d, radix)  # [F, C, N, S']
+        B = F * (1 << (d * radix)) * N
+        flat = strs.reshape(B, 2 * d * radix)
         if bp != B:
             flat = jnp.concatenate(
-                [flat, jnp.zeros((bp - B, 2 * d), bool)]
+                [flat, jnp.zeros((bp - B, 2 * d * radix), bool)]
             )
         return flat
 
@@ -174,14 +176,15 @@ def _flat_fn(d: int, F: int, N: int, bp: int):
     return jax.jit(f)
 
 
-def shard_flat(ks: KernelShard, packed, d: int, F: int, N: int):
+def shard_flat(ks: KernelShard, packed, d: int, F: int, N: int,
+               radix: int = 1):
     """The level's flat share-bit strings, row-sharded over the kernel
     mesh.  ``packed`` may carry any sharding (the client-axis mesh
     layout of the expansion): the flat build runs where packed lives and
     the result reshards onto the kernel submesh — an all-to-all-sized
     move of the SMALL pre-kernel tensor, never a gather onto one
     device."""
-    flat = _flat_fn(d, F, N, ks.bp)(packed)
+    flat = _flat_fn(d, F, N, ks.bp, radix)(packed)
     return jax.device_put(flat, ks.sharding(P(DATA, None)))
 
 

@@ -60,7 +60,37 @@ import numpy as np
 from ..ops import ibdcf, prg
 from ..ops.ibdcf import EvalState, IbDcfKeyBatch
 
-MAX_DIMS = 8  # packed-u32 layout holds d*4 bits
+MAX_DIMS = 8  # packed-u32 layout holds d*4 bits (radix 1; see check_radix)
+
+
+def radix_subtree_nodes(radix: int) -> int:
+    """Nodes in the depth-``radix`` binary subtree below one (dim, side)
+    frontier state: 2 + 4 + … + 2^radix = 2^(radix+1) - 2.  The packed
+    share-bit word stores ALL of them per (dim, side), so a fused level
+    can compare any intermediate depth along a child pattern's path."""
+    return (1 << (radix + 1)) - 2
+
+
+def max_dims_for_radix(radix: int) -> int:
+    """Dim cap keeping 2·d·radix_subtree_nodes(radix) packed bits in one
+    uint32: 8 dims at radix 1 (== MAX_DIMS), 2 at radix 2, 1 at radix 3."""
+    return 32 // (2 * radix_subtree_nodes(radix))
+
+
+def check_radix(d: int, radix: int) -> None:
+    """Validate a crawl radix against the packed-u32 layout — loud, at
+    config-use time, instead of a silent bit collision mid-crawl."""
+    if radix not in (1, 2, 3):
+        raise ValueError(
+            f"crawl_radix_bits={radix}: supported radices are 1, 2, 3"
+        )
+    cap = max_dims_for_radix(radix)
+    if d > cap:
+        raise ValueError(
+            f"crawl_radix_bits={radix} supports at most {cap} dim(s): the "
+            f"packed share-bit word needs 2·d·(2^(radix+1)-2) bits per "
+            f"(node, client) and must fit one uint32; got n_dims={d}"
+        )
 
 # NOTE (round 5): the per-level eval kernel `ops/eval_pallas.py` that once
 # served the RE-EXPANDING fallback `advance` was retired: every crawl path
@@ -669,3 +699,214 @@ def pattern_to_bits(pattern: np.ndarray, d: int) -> np.ndarray:
     """int32[F'] child pattern ids -> bool[F', d] per-dim direction bits
     (bit j = (c >> j) & 1, ref: lib.rs:125-129)."""
     return ((pattern[:, None] >> np.arange(d)[None]) & 1).astype(bool)
+
+
+# ---------------------------------------------------------------------------
+# Radix-2^k level fusion: crawl ``radix`` bits per round trip.
+#
+# A fused level expands every frontier node by all 2^(radix·d) child
+# patterns at once.  Pattern ids are STEP-MAJOR: c = Σ_t step_t << (t·d)
+# with step_t the familiar per-dim pattern of bit-level (base + t), so
+# dim j's direction at step t is ``(c >> (t·d + j)) & 1`` — at radix 1
+# this is exactly the existing child order (lib.rs:125-129), and the
+# fused child of node f sits at ``f·2^(radix·d) + c`` like before.
+#
+# The packed share-bit word generalizes the ``j*4 + s*2 + r`` layout: per
+# (dim j, side s) it stores the share bit of EVERY node in the depth-
+# ``radix`` subtree — depth i's 2^i nodes at offset ``2^i - 2``, node
+# indices little-endian in step order (the child of node m via direction
+# r at depth i+1 is ``m | r << i``) — at position
+#
+#     j·2T + s·T + (2^i - 2) + node_idx,      T = radix_subtree_nodes(radix)
+#
+# which reduces to the radix-1 layout verbatim (T = 2).  Membership of a
+# fused child is the conjunction of its per-depth memberships (the
+# interval predicate is monotone along a path), so equality over the
+# concatenated per-depth strings — what pattern_masks_radix compares —
+# counts exactly what radix-1 would count at the deepest level, and the
+# word still fits u32 at the supported (d, radix) pairs (check_radix).
+# Radix > 1 pins the interleaved/XLA engine: the child cache is a plain
+# EvalState with a 2^radix-wide node axis (the radix-1 cache's direction
+# axis, generalized), so sharding/concat/advance reuse the same paths.
+# ---------------------------------------------------------------------------
+
+
+def _radix_positions(d: int, radix: int, step: int) -> np.ndarray:
+    """uint32[d, 2, 2^(step+1)] — packed-bit positions of every
+    depth-(step+1) subtree node per (dim, side).  Reduces to
+    :func:`_bit_positions` at (radix, step) = (1, 0)."""
+    T = radix_subtree_nodes(radix)
+    j = np.arange(d)[:, None, None]
+    s = np.arange(2)[None, :, None]
+    m = np.arange(2 << step)[None, None, :]
+    return (j * (2 * T) + s * T + ((2 << step) - 2) + m).astype(np.uint32)
+
+
+@lru_cache(maxsize=None)
+def pattern_masks_radix(d: int, radix: int) -> np.ndarray:
+    """uint32[2^(radix·d)] — for fused child pattern c, the packed-bit
+    positions a membership test compares: both sides of every dim at
+    EVERY depth 1..radix along c's path.  ``pattern_masks`` at radix 1."""
+    if radix == 1:
+        return pattern_masks(d)
+    check_radix(d, radix)
+    T = radix_subtree_nodes(radix)
+    masks = []
+    for c in range(1 << (radix * d)):
+        m = np.uint32(0)
+        node = [0] * d  # per-dim subtree node index along c's path
+        for t in range(radix):
+            base = (2 << t) - 2
+            for j in range(d):
+                r = (c >> (t * d + j)) & 1
+                node[j] |= r << t
+                p = np.uint32(j * 2 * T + base + node[j])
+                m |= (np.uint32(1) << p) | (np.uint32(1) << (p + np.uint32(T)))
+        masks.append(m)
+    out = np.array(masks, dtype=np.uint32)
+    out.setflags(write=False)
+    return out
+
+
+def expand_share_bits_radix(
+    keys: IbDcfKeyBatch, frontier: Frontier, level, radix: int,
+    want_children: bool = True, use_pallas: bool | None = None,
+):
+    """:func:`expand_share_bits` crawling ``radix`` bit-levels at once:
+    packed uint32[F, N] carries the share bits of the whole depth-
+    ``radix`` subtree per (node, client), and ``children`` is an
+    :class:`EvalState` cache over ``[F, N, d, 2, 2^radix, …]`` (trailing
+    node axis = the subtree leaves) for :func:`advance_from_children_radix`.
+
+    ``level`` is the BASE bit-level of the fused step (the key batch's
+    correction words at ``level .. level+radix-1`` are consumed); it may
+    be traced, so one compiled program serves every fused level of a
+    crawl.  ``radix`` is the ACTUAL width of this step — the tail level
+    of a crawl whose data_len is not a radix multiple passes its shorter
+    remainder.  Radix 1 delegates to :func:`expand_share_bits` verbatim
+    (same compiled programs, bit-identical crawl)."""
+    if radix == 1:
+        return expand_share_bits(
+            keys, frontier, level,
+            want_children=want_children, use_pallas=use_pallas,
+        )
+    if use_pallas:
+        raise ValueError(
+            "radix > 1 pins the interleaved/XLA expand engine — the "
+            "plane-major Pallas layout has no fused multi-level kernel"
+        )
+    return _expand_radix_jit(
+        keys, frontier, level, prg.DERIVED_BITS, radix, want_children
+    )
+
+
+@partial(jax.jit, static_argnames=("derived_bits", "radix", "want_children"))
+def _expand_radix_jit(keys, frontier, level, derived_bits, radix,
+                      want_children):
+    st = frontier.states  # interleaved [F, N, d, 2, …]
+    d = st.bit.shape[-2]
+    # walk the subtree breadth-first: ``cur`` holds ALL of depth t's
+    # nodes on a trailing node axis M = 2^t, each step expanding every
+    # node into both children (new index = direction·M + m — the
+    # little-endian step order the mask/advance tables assume)
+    cur = EvalState(
+        seed=st.seed[..., None, :], bit=st.bit[..., None],
+        y_bit=st.y_bit[..., None],
+    )
+    packed = jnp.zeros(st.bit.shape[:2], jnp.uint32)  # [F, N]
+    for t in range(radix):
+        cw_seed, cw_bits, cw_y = ibdcf.level_cw(keys, level + t)  # [N,d,2,…]
+        s_l, s_r, tau_b, tau_y = prg.expand(cur.seed, derived_bits)
+        tb = cur.bit[..., None]  # [F, N, d, 2, M, 1]
+        nb = jnp.where(tb, tau_b ^ cw_bits[None, :, :, :, None, :], tau_b)
+        ny = jnp.where(tb, tau_y ^ cw_y[None, :, :, :, None, :], tau_y)
+        ny = ny ^ cur.y_bit[..., None]
+        share = nb ^ ny  # [F, N, d, 2, M, 2] (trailing direction axis)
+        M = share.shape[-2]
+        swap = lambda a: jnp.swapaxes(a, -1, -2).reshape(
+            a.shape[:-2] + (2 * M,)
+        )  # node index = direction·M + m
+        pos = jnp.asarray(_radix_positions(d, radix, t))  # [d, 2, 2M]
+        packed = packed | jnp.sum(
+            swap(share).astype(jnp.uint32) << pos,
+            axis=(-3, -2, -1), dtype=jnp.uint32,
+        )
+        seeds = jnp.stack([s_l, s_r], axis=-3)  # [F, N, d, 2, 2, M, 4]
+        tc = cur.bit[..., None, :, None]  # [F, N, d, 2, 1, M, 1]
+        seeds = jnp.where(
+            tc, seeds ^ cw_seed[None, :, :, :, None, None, :], seeds
+        )
+        cur = EvalState(
+            seed=seeds.reshape(seeds.shape[:-3] + (2 * M, 4)),
+            bit=swap(nb), y_bit=swap(ny),
+        )
+    return packed, (cur if want_children else None)
+
+
+def advance_from_children_radix(
+    children, parent_idx: jax.Array, pattern_bits: jax.Array, n_alive,
+    radix: int,
+) -> Frontier:
+    """:func:`advance_from_children` for a fused level: gather the
+    surviving fused children from the radix cache's subtree-leaf axis.
+
+    pattern_bits: bool[F', radix, d] step-major fused patterns
+    (:func:`pattern_to_bits_radix`).  Radix 1 delegates to the existing
+    advance (same compiled programs)."""
+    if radix == 1:
+        return advance_from_children(
+            children, parent_idx, pattern_bits[:, 0, :], n_alive
+        )
+    return _advance_children_radix_jit(
+        children, parent_idx, pattern_bits, n_alive
+    )
+
+
+@jax.jit
+def _advance_children_radix_jit(children, parent_idx, pattern_bits, n_alive):
+    r = pattern_bits.shape[1]
+    # per-dim subtree-leaf index, little-endian in step order
+    w = (1 << jnp.arange(r, dtype=jnp.int32))[None, :, None]
+    idx = jnp.sum(pattern_bits.astype(jnp.int32) * w, axis=1)  # [F', d]
+    ch = jax.tree.map(lambda a: a[parent_idx], children)  # [F', N, d, 2, R, …]
+    i5 = idx[:, None, :, None, None]
+    states = EvalState(
+        seed=jnp.take_along_axis(
+            ch.seed, i5[..., None], axis=-2
+        )[..., 0, :],
+        bit=jnp.take_along_axis(ch.bit, i5, axis=-1)[..., 0],
+        y_bit=jnp.take_along_axis(ch.y_bit, i5, axis=-1)[..., 0],
+    )
+    alive = jnp.arange(parent_idx.shape[0]) < n_alive
+    return Frontier(states=states, alive=alive)
+
+
+def pattern_to_bits_radix(pattern: np.ndarray, d: int, radix: int) -> np.ndarray:
+    """int[F'] fused child ids -> bool[F', radix, d] per-step direction
+    bits (dim j at step t = ``(c >> (t·d + j)) & 1`` — step-major).
+    ``pattern_to_bits`` with a leading step axis at radix 1."""
+    shift = np.arange(radix)[None, :, None] * d + np.arange(d)[None, None, :]
+    return ((np.asarray(pattern)[:, None, None] >> shift) & 1).astype(bool)
+
+
+@lru_cache(maxsize=None)
+def radix_pattern_order(d: int, radix: int) -> np.ndarray:
+    """int32[2^(radix*d)] — step-major fused pattern ids listed in the
+    k=1 crawl's survivor VISIT order.  The radix-1 crawl emits a level's
+    survivors sorted by per-level pattern with EARLIER levels most
+    significant; the step-major fused id c = Σ_t p_t·2^(t·d) sorts the
+    LAST step most significant, so a fused prune walked in ascending-c
+    order would list the same survivor set in a different order (and,
+    under f_max truncation, could keep a different subset).  Walking
+    fused children as ``order[rank]`` with
+    rank = Σ_t p_t·2^((radix−1−t)·d) restores the k=1 order exactly.
+    Identity at radix=1."""
+    C = 1 << (radix * d)
+    mask = (1 << d) - 1
+    out = np.empty(C, np.int32)
+    for c in range(C):
+        rank = 0
+        for t in range(radix):
+            rank |= ((c >> (t * d)) & mask) << ((radix - 1 - t) * d)
+        out[rank] = c
+    return out

@@ -141,6 +141,13 @@ class Leader:
     # prefetched at the current window's entry so the transfer rides
     # behind ~stream_window levels of compute
     stream_window: int = 64
+    # radix-2^k level fusion (Config.crawl_radix_bits): bits crawled per
+    # round; each run_level call covers bit levels [level, level+r) with
+    # r = min(radix, data_len - level).  Pruning is on the depth-(base+r)
+    # counts, bit-identical to r sequential levels (monotone counts make
+    # the intermediate prunes subsumed — collect.py radix section).
+    # Streaming mode pins radix=1 (advance_from_cw re-expands one bit).
+    radix: int = 1
     # leader-side bookkeeping
     paths: np.ndarray = field(default=None)  # bool[F, d, level]
     n_nodes: int = 0
@@ -151,6 +158,12 @@ class Leader:
     def __post_init__(self):
         if self.obs is None:
             self.obs = obsmetrics.Registry("driver")
+        collect.check_radix(self.n_dims, self.radix)
+        if self.stream and self.radix > 1:
+            raise ValueError(
+                "streaming crawl mode pins crawl_radix_bits=1 "
+                "(advance_from_cw re-expands one bit per level)"
+            )
 
     def tree_init(self):
         for s in (self.server0, self.server1):
@@ -187,9 +200,15 @@ class Leader:
 
         Trusted-exchange mode: counts are exact (the reconstruction
         ``v0 - v1`` of ref collect.rs:945-964, computed directly).
+
+        ``level`` is the BASE bit level of the round; with ``radix`` > 1
+        the round fuses bit levels [level, level + r) for
+        r = min(radix, data_len - level) — one expand, one count, one
+        prune over the 2^(r·d) fused children.
         """
         d = self.n_dims
-        masks = collect.pattern_masks(d)
+        r = min(self.radix, self.data_len - level)
+        masks = collect.pattern_masks_radix(d, r)
         with self.obs.span("level", level=level):
             with self.obs.span("fss", level=level):
                 if self.stream:
@@ -202,11 +221,11 @@ class Leader:
                         cw1, self.server1.frontier, want_children=False
                     )
                 else:
-                    p0, ch0 = collect.expand_share_bits(
-                        self.server0.keys, self.server0.frontier, level
+                    p0, ch0 = collect.expand_share_bits_radix(
+                        self.server0.keys, self.server0.frontier, level, r
                     )
-                    p1, ch1 = collect.expand_share_bits(
-                        self.server1.keys, self.server1.frontier, level
+                    p1, ch1 = collect.expand_share_bits_radix(
+                        self.server1.keys, self.server1.frontier, level, r
                     )
                     self.server0.children, self.server1.children = ch0, ch1
             with self.obs.span("field", level=level):
@@ -224,12 +243,18 @@ class Leader:
                 counts = np.asarray(counts)  # [F, 2^d]
 
                 thresh = max(1, int(threshold * nreqs))  # ref: leader.rs:193-194
-                keep = counts >= thresh  # [F, 2^d]
+                # walk fused children in the k=1 visit order (earlier
+                # steps most significant) so survivor order — and the
+                # f_max truncation set — is bit-identical to r sequential
+                # levels (collect.radix_pattern_order; identity at r=1)
+                order = collect.radix_pattern_order(d, r)
+                keep = counts[:, order] >= thresh  # [F, 2^(r·d)]
                 keep[self.n_nodes :, :] = False
-                parent, pattern, n_alive = collect.compact_survivors(
+                parent, rank, n_alive = collect.compact_survivors(
                     keep, self.f_max, self.min_bucket
                 )
-                pat_bits = collect.pattern_to_bits(pattern, d)
+                pattern = order[rank]
+                pat_bits = collect.pattern_to_bits_radix(pattern, d, r)
 
             with self.obs.span("advance", level=level):
                 if self.stream:
@@ -239,28 +264,33 @@ class Leader:
                         self.server0.frontier = None  # drop refs before donation
                         self.server1.frontier = None
                         self.server0.frontier = collect.advance_from_cw(
-                            cw0, f0, parent, pat_bits, n_alive, self.stream_chunk
+                            cw0, f0, parent, pat_bits[:, 0, :], n_alive,
+                            self.stream_chunk
                         )
                         # free server 0's old frontier BEFORE server 1 advances:
                         # keeping both olds + both news alive is what overflows
                         # HBM at wide-frontier levels (four full frontiers)
                         del f0
                         self.server1.frontier = collect.advance_from_cw(
-                            cw1, f1, parent, pat_bits, n_alive, self.stream_chunk
+                            cw1, f1, parent, pat_bits[:, 0, :], n_alive,
+                            self.stream_chunk
                         )
                         del f1
                 else:
                     for s in (self.server0, self.server1):
-                        s.frontier = collect.advance_from_children(
-                            s.children, parent, pat_bits, n_alive
+                        s.frontier = collect.advance_from_children_radix(
+                            s.children, parent, pat_bits, n_alive, r
                         )
                         s.children = None
 
-            # leader-side path bookkeeping (child bit j = (pattern >> j) & 1)
-            new_paths = np.zeros((n_alive, d, self.paths.shape[-1] + 1), bool)
+            # leader-side path bookkeeping (step t's bit for dim j =
+            # (pattern >> (t·d + j)) & 1 — the fused path appends r bits
+            # per dim, step-major)
+            new_paths = np.zeros((n_alive, d, self.paths.shape[-1] + r), bool)
             for i in range(n_alive):
-                new_paths[i, :, :-1] = self.paths[parent[i]]
-                new_paths[i, :, -1] = pat_bits[i]
+                new_paths[i, :, : -r] = self.paths[parent[i]]
+                for t in range(r):
+                    new_paths[i, :, -r + t] = pat_bits[i, t]
             self.paths = new_paths
             self.n_nodes = n_alive
             self.obs.gauge("survivors", n_alive, level=level)
@@ -308,17 +338,18 @@ class Leader:
         # finished crawl has nothing to resume) — and silently write
         # nothing at all
         every = min(checkpoint_every, max(1, self.data_len // 2))
-        for level in range(start, self.data_len):
+        for level in range(start, self.data_len, self.radix):
+            r = min(self.radix, self.data_len - level)
             n = self.run_level(level, nreqs, threshold)
             if n == 0:
                 return done(CrawlResult(
-                    paths=np.zeros((0, self.n_dims, level + 1), bool),
+                    paths=np.zeros((0, self.n_dims, level + r), bool),
                     counts=np.zeros(0, np.uint32),
                 ))
             if (
                 checkpoint_path is not None
-                and level < self.data_len - 1
-                and (level + 1) % every == 0
+                and level + r < self.data_len
+                and (level + r) % every == 0
             ):
                 self.checkpoint(checkpoint_path, level, nreqs, threshold)
         return done(CrawlResult(paths=self.paths, counts=self._last_counts))
@@ -423,6 +454,7 @@ class Leader:
         planar = collect._expand_engine()
         blob = {
             "level": np.int64(level),
+            "radix": np.int64(self.radix),
             "planar": np.bool_(planar),
             "paths": self.paths,
             "n_nodes": np.int64(self.n_nodes),
@@ -473,6 +505,16 @@ class Leader:
             raise ValueError(
                 f"checkpoint shape {list(meta)} != leader shape {want}"
             )
+        # validate-before-mutate: a blob written under a different crawl
+        # radix carries a frontier at a depth this leader's fused level
+        # grid never visits — refuse with live state untouched (blobs
+        # predating the radix stamp are radix-1 crawls)
+        saved_radix = int(z["radix"]) if "radix" in z else 1
+        if saved_radix != self.radix:
+            raise ValueError(
+                f"checkpoint crawl radix {saved_radix} != leader "
+                f"crawl_radix_bits {self.radix}"
+            )
         if "key_fp" not in z:
             raise ValueError(
                 "checkpoint predates the key-fingerprint format — "
@@ -511,7 +553,8 @@ class Leader:
         self._win_next = {}
         self.obs.count("checkpoint_restores", level=int(z["level"]))
         obsmod.emit("checkpoint.restore", path=path, level=int(z["level"]))
-        return int(z["level"]) + 1
+        lvl = int(z["level"])  # base bit level of the last completed round
+        return lvl + min(self.radix, self.data_len - lvl)
 
 
 def _convert_layout(states, from_planar: bool):

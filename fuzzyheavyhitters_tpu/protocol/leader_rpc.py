@@ -210,12 +210,16 @@ class RpcLeader:
         own retry (:meth:`_shard_call`).  A mid-level fault costs the
         lost span(s), not the level."""
         verb = "tree_crawl_last" if last else "tree_crawl"
-        # alternate the garbling server per level (the reference's
-        # gc_sender flip, leader.rs:204-210) to split garbling cost; the
-        # equality-test path rides the verb too, so both servers follow
-        # THIS leader's config even when it differs from their own (the
-        # bench's GC-reference leg depends on that)
-        req = {"level": level, "garbler": level % 2,
+        # alternate the garbling server per FUSED level (the reference's
+        # gc_sender flip, leader.rs:204-210) to split garbling cost; under
+        # radix-2^k fusion the bases are 0, k, 2k, … so the flip counts
+        # round trips (level // k), not bit-levels — level % 2 would pin
+        # one garbler forever at even k.  The equality-test path rides the
+        # verb too, so both servers follow THIS leader's config even when
+        # it differs from their own (the bench's GC-reference leg depends
+        # on that)
+        rdx = max(1, int(self.cfg.crawl_radix_bits))
+        req = {"level": level, "garbler": (level // rdx) % 2,
                "ot_path": self.cfg.ot_path}
         spans = collect.shard_spans(self._f_bucket, self.cfg.crawl_shard_nodes)
         if self.cfg.secure_exchange and self.cfg.secure_whole_level:
@@ -383,12 +387,17 @@ class RpcLeader:
 
     async def _run_one_level(self, level: int, nreqs: int, thresh: int):
         """One crawl->reconstruct->threshold->prune round under a level
-        span (the heartbeat names this level while it runs).  Returns
-        ``(counts_kept, alive_after_verify)`` with ``counts_kept`` None
-        when the crawl died out at this level."""
+        span (the heartbeat names this level while it runs).  Under
+        radix-2^k fusion (``cfg.crawl_radix_bits``) ``level`` is the BASE
+        bit-level of the fused step and the round covers bit-levels
+        ``level .. level+r-1`` with ``r = min(k, L - level)`` — one round
+        trip per fused level, 2^(d·r) count columns, and ``r`` path bits
+        appended per dim.  Returns ``(counts_kept, alive_after_verify)``
+        with ``counts_kept`` None when the crawl died out at this level."""
         cfg = self.cfg
         d, L = cfg.n_dims, cfg.data_len
-        last = level == L - 1
+        r = min(max(1, int(cfg.crawl_radix_bits)), L - level)
+        last = level + r == L
         alive_after_verify = None
         if self.has_sketch and level != 1:
             # malicious-security gate first, so failing clients'
@@ -413,22 +422,32 @@ class RpcLeader:
             if np.any(v > nreqs):  # e.g. a share-sign/role mismatch
                 raise RuntimeError("count reconstruction out of range")
             counts = v.astype(np.uint32)
-        keep = counts >= thresh
+        # radix_pattern_order permutes the fused (step-major) child
+        # columns into the order a k=1 crawl would visit them, so
+        # compact_survivors' walk — and therefore any f_max truncation —
+        # is bit-identical to the sequential crawl (identity at r=1)
+        order = collect.radix_pattern_order(d, r)
+        keep = counts[:, order] >= thresh
         keep[self.n_nodes :, :] = False
-        parent, pattern, n_alive = collect.compact_survivors(
+        parent, rank, n_alive = collect.compact_survivors(
             keep, cfg.f_max, self.min_bucket
         )
-        pat_bits = collect.pattern_to_bits(pattern, d)
+        pattern = order[rank]
+        pat_bits = collect.pattern_to_bits_radix(pattern, d, r)
         self.obs.gauge("survivors", n_alive, level=level)
         if n_alive == 0:
             return None, alive_after_verify
         self._f_bucket = int(parent.shape[0])  # next level's shard plan
+        # r == 1 sends the historical 2-D [F', d] pattern wire (the
+        # transcript ratchet absorbs the wire bytes — k=1 must stay
+        # digest-identical); r > 1 sends the fused [F', r, d] form
+        wire_bits = pat_bits[:, 0, :] if r == 1 else pat_bits
         if last:
             await self._both(
                 "tree_prune_last",
                 {
                     "parent_idx": parent,
-                    "pattern_bits": pat_bits,
+                    "pattern_bits": wire_bits,
                     "n_alive": n_alive,
                 },
             )
@@ -438,14 +457,15 @@ class RpcLeader:
                 {
                     "level": level,
                     "parent_idx": parent,
-                    "pattern_bits": pat_bits,
+                    "pattern_bits": wire_bits,
                     "n_alive": n_alive,
                 },
             )
-        new_paths = np.zeros((n_alive, d, self.paths.shape[-1] + 1), bool)
+        new_paths = np.zeros((n_alive, d, self.paths.shape[-1] + r), bool)
         for i in range(n_alive):
-            new_paths[i, :, :-1] = self.paths[parent[i]]
-            new_paths[i, :, -1] = pat_bits[i]
+            new_paths[i, :, : -r] = self.paths[parent[i]]
+            for t in range(r):
+                new_paths[i, :, -r + t] = pat_bits[i, t]
         self.paths = new_paths
         self.n_nodes = n_alive
         return counts[parent[:n_alive], pattern[:n_alive]], alive_after_verify
@@ -469,7 +489,12 @@ class RpcLeader:
         thresh = max(1, int(cfg.threshold * nreqs))
         counts_kept = np.zeros(0, np.uint32)
         alive_before_leaf = None  # liveness after the latest verify
-        for level in range(L):
+        # radix-2^k fusion: one round trip per FUSED level — bases
+        # 0, k, 2k, …, ⌈L/k⌉ rounds total (the tail round covers the
+        # remaining L mod k bit-levels when k ∤ L)
+        rdx = max(1, int(cfg.crawl_radix_bits))
+        for level in range(0, L, rdx):
+            r = min(rdx, L - level)
             with self.obs.span("level", level=level) as sp_level:
                 counts_kept, alive = await self._run_one_level(
                     level, nreqs, thresh
@@ -481,7 +506,7 @@ class RpcLeader:
                 alive_before_leaf = alive
             if counts_kept is None:
                 return CrawlResult(
-                    paths=np.zeros((0, d, level + 1), bool),
+                    paths=np.zeros((0, d, level + r), bool),
                     counts=np.zeros(0, np.uint32),
                 )
         if self.has_sketch and L > 1:
@@ -641,7 +666,13 @@ class RpcLeader:
             level=level,
             restarted_servers=restarted,
         )
-        return level + 1
+        # next base on the fused level grid: a checkpoint banks the state
+        # AFTER the fused level at base ``level``, so the crawl resumes at
+        # level + r (level -1 is the sketch init checkpoint: resume at 0)
+        rdx = max(1, int(self.cfg.crawl_radix_bits))
+        if level < 0:
+            return 0
+        return level + min(rdx, self.cfg.data_len - level)
 
     async def run_supervised(
         self,
@@ -759,7 +790,11 @@ class RpcLeader:
                 )
         recoveries = 0
         level = 0
+        rdx = max(1, int(cfg.crawl_radix_bits))
         while level < L:
+            # radix-2^k fusion: this round covers bit-levels
+            # level .. level+r-1; the next base is level + r
+            r = min(rdx, L - level)
             try:
                 with self.obs.span("level", level=level) as sp_level:
                     counts_kept, alive = await self._run_one_level(
@@ -770,13 +805,13 @@ class RpcLeader:
                     alive_before_leaf = alive
                 if counts_kept is None:
                     return CrawlResult(
-                        paths=np.zeros((0, d, level + 1), bool),
+                        paths=np.zeros((0, d, level + r), bool),
                         counts=np.zeros(0, np.uint32),
                     )
                 if (
                     ckpt_enabled
-                    and level < L - 1
-                    and (level + 1) % checkpoint_every == 0
+                    and level + r < L
+                    and (level + r) % checkpoint_every == 0
                 ):
                     try:
                         await self._both("tree_checkpoint", {"level": level})
@@ -802,7 +837,7 @@ class RpcLeader:
                             severity="warn",
                             error=str(e),
                         )
-                level += 1
+                level += r
             except (ConnectionError, TimeoutError, RuntimeError) as err:
                 while True:
                     recoveries += 1

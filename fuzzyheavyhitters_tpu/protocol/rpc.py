@@ -593,7 +593,7 @@ class CollectorServer:
                 )
                 sides.append(p)
             pairs_fn = jnp.stack(sides)  # [2, N, d, LANES(, limbs)]
-            dpf_level = 0
+            checks = [(pairs_fn, 0, fld, 0)]
         else:
             if L == 1:
                 # the level-0 full check already consumed triples_last; a
@@ -611,57 +611,78 @@ class CollectorServer:
                     "depth 1 is covered by the level-0 full check; "
                     "re-verifying it would re-open its Beaver triples"
                 )
-            if cs._sketch_pairs is None or cs._sketch_pairs[1] != level:
+            if cs._sketch_pairs is None or cs._sketch_pairs[-1][1] != level:
                 raise RuntimeError(f"no stored sketch shares for depth {level}")
-            pairs_fn, _ = cs._sketch_pairs  # [F, N, d, LANES(, limbs)]
-            fld = cs._sketch_pairs_field
-            last = fld is F255
-            dpf_level = level - 1
-        challenge = cs.challenge_seed(level)
-        # device-resident, row-sharded verify (parallel/sketch_shard.py):
-        # the WHOLE level's check batch runs as one fused program per
-        # stage — sharded along the client axis across the data mesh
-        # when one is bound — with the challenge stream derived PER
-        # SHARD by CTR seek (bit-identical to the single-device draw),
-        # per-shard readbacks reassembled positionally into a
-        # byte-identical wire, and a single post-level verdict readback.
-        # The old sketch_batch_size host loop (one dispatch + TWO wire
-        # round trips per chunk) survives only in the spec helper
-        # (sketch.verify_level).
+            # radix fusion: the latest prune stored one (pairs, depth,
+            # field) entry per bit level it fused — verify each stored
+            # depth under its OWN ratcheted challenge and Beaver slab
+            # (every slab still opens exactly once).  Depth 1 is dropped:
+            # the level-0 full check consumed its triples, and re-opening
+            # them under a second challenge would leak <r - r', x>.
+            checks = [
+                (p, dep - 1, f, dep)
+                for (p, dep, f) in cs._sketch_pairs
+                if dep >= 2
+            ]
         sk = cs._sketch
-        if last:
-            trip, mk, mk2 = sk.triples_last, sk.mac_key_last, sk.mac_key2_last
-        else:
-            # host slab slice: sketch key leaves are host numpy (the
-            # uploaded chunks), so the per-level slab costs no dispatch
-            trip = mpc.level_slab(sk.triples, dpf_level)
-            mk, mk2 = sk.mac_key, sk.mac_key2
         ss = self._sketch_bind(cs, n, d)
         cs.obs.gauge(
             "sketch_shards", 1 if ss is None else ss.k, level=level
         )
+        # device-resident, row-sharded verify (parallel/sketch_shard.py):
+        # each stored depth's check batch runs as one fused program per
+        # stage — sharded along the client axis across the data mesh
+        # when one is bound — with the challenge stream derived PER
+        # SHARD by CTR seek (bit-identical to the single-device draw),
+        # per-shard readbacks reassembled positionally into a
+        # byte-identical wire, and a single post-depth verdict readback.
+        # Both servers walk the identical check list in depth order, so
+        # the data-plane swap sequence stays matched.  The old
+        # sketch_batch_size host loop (one dispatch + TWO wire round
+        # trips per chunk) survives only in the spec helper
+        # (sketch.verify_level).
         with cs.obs.span("sketch", level=level):
-            cor, state = sketch_shard.cor_state(
-                ss, fld, pairs_fn, trip, mk, mk2, challenge, level
-            )
-            cs.obs.count(
-                "device_fetches", 1 if ss is None else ss.k, level=level
-            )
-            # cor exchange: per-shard D2H copies assembled positionally
-            # into ONE wire message (sketch_shard.wire starts the DMAs)
-            cor_np = await asyncio.to_thread(sketch_shard.wire, cor)
-            peer_cor = await self._swap(cs, cor_np)
-            o = sketch_shard.out_shares(
-                ss, fld, state, cor, peer_cor, bool(self.server_id)
-            )
-            cs.obs.count(
-                "device_fetches", 1 if ss is None else ss.k, level=level
-            )
-            o_np = await asyncio.to_thread(sketch_shard.wire, o)
-            peer_o = await self._swap(cs, o_np)
-            ok_dev = sketch_shard.verdicts(ss, fld, o, peer_o)
-            # the level's SINGLE post-level readback: the verdict vector
-            ok = await _fetch(ok_dev, cs.obs, level=level)
+            ok_all = None
+            for pairs_fn, dpf_level, fld, depth in checks:
+                if fld is F255:
+                    trip, mk, mk2 = (
+                        sk.triples_last, sk.mac_key_last, sk.mac_key2_last
+                    )
+                else:
+                    # host slab slice: sketch key leaves are host numpy
+                    # (the uploaded chunks), so the per-level slab costs
+                    # no dispatch
+                    trip = mpc.level_slab(sk.triples, dpf_level)
+                    mk, mk2 = sk.mac_key, sk.mac_key2
+                challenge = cs.challenge_seed(depth)
+                cor, state = sketch_shard.cor_state(
+                    ss, fld, pairs_fn, trip, mk, mk2, challenge, depth
+                )
+                cs.obs.count(
+                    "device_fetches", 1 if ss is None else ss.k, level=level
+                )
+                # cor exchange: per-shard D2H copies assembled positionally
+                # into ONE wire message (sketch_shard.wire starts the DMAs)
+                cor_np = await asyncio.to_thread(sketch_shard.wire, cor)
+                peer_cor = await self._swap(cs, cor_np)
+                o = sketch_shard.out_shares(
+                    ss, fld, state, cor, peer_cor, bool(self.server_id)
+                )
+                cs.obs.count(
+                    "device_fetches", 1 if ss is None else ss.k, level=level
+                )
+                o_np = await asyncio.to_thread(sketch_shard.wire, o)
+                peer_o = await self._swap(cs, o_np)
+                ok_dev = sketch_shard.verdicts(ss, fld, o, peer_o)
+                # per-depth verdicts AND on device; exclusion is a
+                # conjunction, so one batched readback after the loop is
+                # bit-identical to a fetch per depth
+                ok_all = ok_dev if ok_all is None else ok_all & ok_dev
+            # the check batch's SINGLE post-verify readback: the verdict
+            # vector (checks is never empty — level 0 contributes its
+            # full check, a fused prune always stores its final depth)
+            ok = await _fetch(ok_all, cs.obs, level=level)
+            cs.alive_keys &= ok
         if level != 0:
             # one-shot within a boot: each stored depth's pairs open once;
             # a same-boot duplicate call is answered by the session dedup
@@ -671,7 +692,6 @@ class CollectorServer:
             # opening.  The level-0 path has no stored pairs and re-runs
             # under the same identical-challenge argument.
             cs._sketch_pairs = None
-        cs.alive_keys &= ok
         return cs.alive_keys.copy()
 
     # data-plane framing with byte/message accounting; levels attribute
@@ -752,13 +772,18 @@ class CollectorServer:
         (keys, frontier, level, span), so a shard re-run may reuse it
         bit-identically."""
         frontier = cs.shard_frontier_view(shard)
-        packed, children = collect.expand_share_bits(
-            cs.keys, frontier, level, want_children=not last,
+        # radix-2^k fusion: this span covers bit levels [level, level+r)
+        # — r = 1 is exactly the pre-radix program (expand_share_bits_radix
+        # and child_strings_radix delegate to the radix-1 entry points)
+        r = cs.crawl_radix(level)
+        packed, children = collect.expand_share_bits_radix(
+            cs.keys, frontier, level, r, want_children=not last,
             use_pallas=False if cs._mesh is not None else None,
         )
         out = {"packed": packed, "children": children, "frontier": frontier}
         if self.cfg.secure_exchange:
             d = cs.keys.cw_seed.shape[1]
+            S = 2 * d * r  # fused equality string width S'
             if cs._mesh is not None:
                 # row-sharded kernel stage (parallel/kernel_shard.py):
                 # the whole-level planar test batch partitions along its
@@ -767,16 +792,16 @@ class CollectorServer:
                 # byte-identical wire, so nothing between FSS expansion
                 # and the frame serializes onto one device
                 F_, N = packed.shape
-                C = 1 << d
+                C = 1 << (d * r)
                 B = F_ * C * N
                 ks = cs._mesh.kernel_bind(
-                    B, 2 * d, self.cfg.secure_kernel_shards
+                    B, S, self.cfg.secure_kernel_shards
                 )
                 if ks is not None:
                     out["flat"] = kernel_shard.shard_flat(
-                        ks, packed, d, F_, N
+                        ks, packed, d, F_, N, r
                     )
-                    out["dims"] = (F_, C, N, 2 * d)
+                    out["dims"] = (F_, C, N, S)
                     out["kernel"] = ks
                     return out
                 # degraded path (batch fills a single planar block, or
@@ -794,7 +819,7 @@ class CollectorServer:
                     "kernel_gather", time.monotonic() - t0, level=int(level)
                 )
                 cs.obs.count("kernel_gathers", level=int(level))
-            strs = secure.child_strings(packed, d)  # [F, C, N, S]
+            strs = secure.child_strings_radix(packed, d, r)  # [F, C, N, S']
             F_, C, N, S = strs.shape
             out["flat"] = strs.reshape(F_ * C * N, S)
             out["dims"] = (F_, C, N, S)
@@ -871,7 +896,9 @@ class CollectorServer:
             # data plane: swap packed share bits with the peer server
             peer = await self._swap(cs, packed_np)
         with cs.obs.span("field", level=level) as sp_field:
-            masks = collect.pattern_masks(cs.keys.cw_seed.shape[1])
+            masks = collect.pattern_masks_radix(
+                cs.keys.cw_seed.shape[1], cs.crawl_radix(level)
+            )
             counts = await self._reduced_fetch(
                 cs, level, collect.counts_by_pattern,
                 packed, peer, masks, cs.alive_keys, frontier.alive,
@@ -1294,18 +1321,43 @@ class CollectorServer:
         # fhh-lint: disable=host-sync-in-hot-loop (wire input: host numpy)
         pat_bits = np.asarray(req["pattern_bits"], bool)
         n_alive = int(req["n_alive"])
+        # radix wire shape: [F', d] is a radix-1 prune, [F', r, d] a
+        # fused one (r bits per dim, step-major — the leader derives it
+        # from the same crawl_radix_bits knob this session holds)
+        r = 1 if pat_bits.ndim == 2 else pat_bits.shape[1]
+        if r != cs.crawl_radix(level):
+            raise RuntimeError(
+                f"prune pattern carries {r} step bit(s) where this "
+                f"session's level-{int(level)} round fuses "
+                f"{cs.crawl_radix(level)} (crawl_radix_bits mismatch "
+                f"between leader and server?)"
+            )
+        pb1 = pat_bits if pat_bits.ndim == 2 else pat_bits[:, 0, :]
         cs._expand_ready.clear()  # the frontier is about to mutate
         if cs._children is None and cs._shard_children:
             cs._children = cs.assemble_shard_children()
         if cs._children is not None:  # cache from this level's crawl
-            cs.frontier = collect.advance_from_children(
-                cs._children, parent, pat_bits, n_alive
-            )
+            if r == 1:
+                cs.frontier = collect.advance_from_children(
+                    cs._children, parent, pb1, n_alive
+                )
+            else:
+                cs.frontier = collect.advance_from_children_radix(
+                    cs._children, parent, pat_bits, n_alive, r
+                )
             cs._children = None
-        else:  # prune without a preceding crawl: re-expand
+        elif r == 1:  # prune without a preceding crawl: re-expand
             cs.frontier = collect.advance(
-                cs.keys, cs.frontier, level, parent, pat_bits, n_alive,
+                cs.keys, cs.frontier, level, parent, pb1, n_alive,
                 use_pallas=False if cs._mesh is not None else None,
+            )
+        else:  # fused prune without a crawl cache: re-expand r bits
+            _, children = collect.expand_share_bits_radix(
+                cs.keys, cs.frontier, level, r, want_children=True,
+                use_pallas=False if cs._mesh is not None else None,
+            )
+            cs.frontier = collect.advance_from_children_radix(
+                children, parent, pat_bits, n_alive, r
             )
         if cs._sketch is not None:
             cs.advance_sketch(int(level), parent, pat_bits, n_alive)
@@ -1340,17 +1392,32 @@ class CollectorServer:
         # fhh-lint: disable=host-sync-in-hot-loop (wire input: host numpy)
         pattern = np.asarray(req["pattern_bits"], bool)
         n_alive = int(req["n_alive"])
-        d = pattern.shape[1]
-        child = (pattern[:n_alive] << np.arange(d)).sum(axis=1)
+        L = cs.keys.cw_seed.shape[-2]
+        if pattern.ndim == 2:  # radix-1 wire shape [F', d]
+            d = pattern.shape[1]
+            child = (pattern[:n_alive] << np.arange(d)).sum(axis=1)
+            base = L - 1
+        else:  # fused leaf prune [F', r, d]: step-major fused child id
+            r_, d = pattern.shape[1], pattern.shape[2]
+            base = L - r_
+            if r_ != cs.crawl_radix(base):
+                raise RuntimeError(
+                    f"leaf prune pattern carries {r_} step bit(s) where "
+                    f"this session's tail round fuses "
+                    f"{cs.crawl_radix(base)}"
+                )
+            shift = np.arange(r_)[:, None] * d + np.arange(d)[None, :]
+            child = (
+                pattern[:n_alive].astype(np.int64) << shift
+            ).sum(axis=(1, 2))
         cs._last_shares = cs._last_shares[parent[:n_alive], child]
         if cs._sketch is not None:
-            L = cs.keys.cw_seed.shape[-2]
             cs.advance_sketch(
                 # fhh-lint: disable=host-sync-in-hot-loop (wire input)
-                L - 1, np.asarray(req["parent_idx"], np.int32), pattern, n_alive
+                base, np.asarray(req["parent_idx"], np.int32), pattern, n_alive
             )
             cs._ratchet_digest = sketchmod.transcript_absorb(
-                cs._ratchet_digest, L - 1, parent, pattern, n_alive
+                cs._ratchet_digest, base, parent, pattern, n_alive
             )
         cs.obs.gauge(
             "survivors", n_alive, level=cs.keys.cw_seed.shape[-2] - 1
@@ -1670,6 +1737,10 @@ class CollectorServer:
             "ing_only": np.bool_(True),
             "sess": np.str_(cs.key),
             "level": np.int64(-1),
+            # crawl-radix stamp: the destination session must crawl the
+            # same fused level grid (its window_load rebuilds crawl state
+            # and its sketch ratchet absorbs one fused level per step)
+            "radix": np.int64(cs._radix),
         }
         cs.ingest_ckpt_fields(blob)
         cs._export_epoch += 1
@@ -1766,6 +1837,17 @@ class CollectorServer:
                 "session_import: only ingest-form blobs migrate (crawl "
                 "state rebuilds from the pools via window_load); use "
                 "add_keys + tree_restore for a crawl-level checkpoint"
+            )
+        # crawl-radix stamp (validate-before-mutate, both directions):
+        # an exported k=2 session refuses to land in a k=1 session and
+        # vice versa — the fused level grid and the sketch ratchet's
+        # per-step absorption must match across the migration
+        xp_radix = int(z["radix"]) if "radix" in z else 1
+        if xp_radix != cs._radix:
+            raise RuntimeError(
+                f"session_import: blob at {path} was exported under "
+                f"crawl_radix_bits={xp_radix}; this session runs "
+                f"crawl_radix_bits={cs._radix}"
             )
         # validate the whole ing_* tail BEFORE any state mutates
         parsed = cs.ingest_validate(z, path)
@@ -2011,7 +2093,10 @@ class CollectorServer:
                 fetch["sk_state_seed"] = cs._sketch_states.seed
                 fetch["sk_state_t"] = cs._sketch_states.t
                 if cs._sketch_pairs is not None:
-                    fetch["sk_pairs"] = cs._sketch_pairs[0]
+                    # one entry per fused bit level (radix-2^k crawls
+                    # store several); indexed keys keep the npz flat
+                    for i, (p, _, _) in enumerate(cs._sketch_pairs):
+                        fetch[f"sk_pairs_{i}"] = p
             blob = jax.device_get(fetch)
             blob["alive_keys"] = np.asarray(cs.alive_keys)
             blob["planar"] = np.bool_(cs.planar())
@@ -2023,6 +2108,10 @@ class CollectorServer:
         # session BEFORE any state mutates (satellite of the PR-4
         # validate-before-mutate contract)
         blob["sess"] = np.str_(cs.key)
+        # crawl-radix stamp: a blob written under k=2 holds a frontier at
+        # a depth grid (0, 2, 4, …) a k=1 session never visits — restore
+        # refuses a mismatch before any state mutates
+        blob["radix"] = np.int64(cs._radix)
         cs.ingest_ckpt_fields(blob)
         if cs._sketch is not None:
             blob["sk_pids"] = np.asarray(cs._sketch_pids)
@@ -2032,9 +2121,11 @@ class CollectorServer:
                 cs._ratchet_digest, np.uint8
             )
             if cs._sketch_pairs is not None:
-                blob["sk_pairs_depth"] = np.int64(cs._sketch_pairs[1])
-                blob["sk_pairs_last"] = np.bool_(
-                    cs._sketch_pairs_field is F255
+                blob["sk_pairs_depth"] = np.asarray(
+                    [dep for (_, dep, _) in cs._sketch_pairs], np.int64
+                )
+                blob["sk_pairs_last"] = np.asarray(
+                    [f is F255 for (_, _, f) in cs._sketch_pairs], bool
                 )
         path = cs.ckpt_path(level)
         tmp = f"{path}.tmp{os.getpid()}"
@@ -2097,6 +2188,17 @@ class CollectorServer:
                 f"tree_restore: checkpoint at {path} is stamped for "
                 f"collection {str(z['sess'])!r}, not {cs.key!r} "
                 "(renamed across session namespaces?)"
+            )
+        # crawl-radix stamp (validate-before-mutate, both directions): a
+        # k=2 blob into a k=1 session — or vice versa — refuses with live
+        # state untouched (blobs predating the stamp are radix-1 crawls)
+        saved_radix = int(z["radix"]) if "radix" in z else 1
+        if saved_radix != cs._radix:
+            raise RuntimeError(
+                f"tree_restore: checkpoint at {path} was written under "
+                f"crawl_radix_bits={saved_radix}; this session runs "
+                f"crawl_radix_bits={cs._radix} — its level grid never "
+                "visits the blob's frontier depth"
             )
         if "ing_only" in z and bool(z["ing_only"]):
             # ingest-only blob: pools back, crawl state untouched-empty.
@@ -2229,16 +2331,28 @@ class CollectorServer:
             cs._ratchet_digest = np.asarray(
                 z["sk_digest"], np.uint8
             ).tobytes()
-            if "sk_pairs" in z:
-                cs._sketch_pairs = (
-                    jax.device_put(z["sk_pairs"]), int(z["sk_pairs_depth"])
-                )
-                cs._sketch_pairs_field = (
-                    F255 if bool(z["sk_pairs_last"]) else FE62
-                )
+            if "sk_pairs_0" in z:
+                # fhh-lint: disable=host-sync-in-hot-loop (as above)
+                depths = np.atleast_1d(np.asarray(z["sk_pairs_depth"]))
+                # fhh-lint: disable=host-sync-in-hot-loop (as above)
+                lasts = np.atleast_1d(np.asarray(z["sk_pairs_last"]))
+                cs._sketch_pairs = [
+                    (
+                        jax.device_put(z[f"sk_pairs_{i}"]),
+                        int(depths[i]),
+                        F255 if bool(lasts[i]) else FE62,
+                    )
+                    for i in range(len(depths))
+                ]
+            elif "sk_pairs" in z:
+                # pre-radix blob form: a single stored pair
+                cs._sketch_pairs = [(
+                    jax.device_put(z["sk_pairs"]),
+                    int(z["sk_pairs_depth"]),
+                    F255 if bool(z["sk_pairs_last"]) else FE62,
+                )]
             else:
                 cs._sketch_pairs = None
-                cs._sketch_pairs_field = None
         if parsed_ing is not None:
             cs.ingest_restore_apply(parsed_ing)
         cs.obs.count("checkpoint_restores", level=level)
@@ -2406,6 +2520,10 @@ class CollectorServer:
             mesh_shards,
             int(self.cfg.secure_kernel_shards),
             cs.planar(),
+            # radix-2^k fusion: k changes every compiled shape downstream
+            # of expand (packed bit layout, S' = 2·d·k equality width,
+            # C = 2^(d·k) count columns, fused sketch advance)
+            int(cs._radix),
             # malicious lane: the sketch verify ladder compiles its own
             # fused per-bucket programs, sharded by the sketch plan
             bool(cs._sketch_parts) or cs._sketch is not None,
@@ -2435,18 +2553,30 @@ class CollectorServer:
         else:
             fr = collect.tree_init(cs.keys, fb)
         d = cs.keys.cw_seed.shape[1]
-        lasts = (False, True) if L > 1 else (True,)
-        for last in lasts:
-            level = L - 1 if last else 0
-            packed, _ = collect.expand_share_bits(
-                cs.keys, fr, level, want_children=not last,
+        # radix-2^k fusion: the live crawl dispatches at most two fused
+        # shapes — (r = k, inner level, FE62, with children) and
+        # (r = tail, leaf-bearing level, F255, no children), where the
+        # tail radix is L - k·⌊(L-1)/k⌋ (== k when k divides L).  At
+        # k = 1 this reduces exactly to the historical (False, True)
+        # `lasts` ladder, via the radix==1 delegation in collect/secure.
+        rdx = cs._radix
+        base_last = rdx * ((L - 1) // rdx)
+        steps = (
+            [(min(rdx, L), True)]
+            if base_last == 0
+            else [(rdx, False), (L - base_last, True)]
+        )
+        for r, last in steps:
+            level = base_last if last else 0
+            packed, _ = collect.expand_share_bits_radix(
+                cs.keys, fr, level, r, want_children=not last,
                 use_pallas=False if mesh is not None else None,
             )
             if self.cfg.secure_exchange:
                 N = cs.keys.cw_seed.shape[0]
                 ks = (
                     mesh.kernel_bind(
-                        fb * (1 << d) * N, 2 * d,
+                        fb * (1 << (d * r)) * N, 2 * d * r,
                         self.cfg.secure_kernel_shards,
                     )
                     if mesh is not None
@@ -2459,7 +2589,7 @@ class CollectorServer:
                     # gathered twins would leave every live program cold
                     secure.warm_level_kernels_sharded(
                         ks, packed, d, fb, N, F255 if last else FE62,
-                        path=ot_path or self.cfg.ot_path,
+                        path=ot_path or self.cfg.ot_path, radix=r,
                     )
                     continue
                 secure.warm_level_kernels(
@@ -2471,9 +2601,10 @@ class CollectorServer:
                     path=ot_path or self.cfg.ot_path,
                     share_sums=mesh.node_share_sums if mesh is not None
                     else None,
+                    radix=r,
                 )
             else:
-                masks = collect.pattern_masks(d)
+                masks = collect.pattern_masks_radix(d, r)
                 alive = (
                     cs.alive_keys
                     if cs.alive_keys is not None

@@ -81,6 +81,48 @@ def child_strings(packed: jax.Array, d: int) -> jax.Array:
     return ((packed[:, None, :, None] >> pos[None, :, None, :]) & 1).astype(bool)
 
 
+def _string_positions_radix(d: int, radix: int) -> np.ndarray:
+    """uint32[2^(radix*d), 2*d*radix] — packed-bit positions of fused child
+    pattern c's compared string under the radix layout (collect.py
+    ``_radix_positions``).  The fused string is the step-major concatenation
+    of the per-depth membership strings along c's path: column
+    k = t*2d + j*2 + s holds dim j / side s of the depth-(t+1) node reached
+    by steps 0..t.  Equality over the concatenation == AND of the per-depth
+    equalities, which is what makes fused pruning identical to k sequential
+    radix-1 prunes.  Reduces to ``_string_positions`` columns at radix=1."""
+    if radix == 1:
+        return _string_positions(d)
+    T = (1 << (radix + 1)) - 2  # packed bits per (dim, side)
+    C = 1 << (radix * d)
+    out = np.empty((C, 2 * d * radix), np.uint32)
+    for c in range(C):
+        node = [0] * d
+        k = 0
+        for t in range(radix):
+            base = (2 << t) - 2  # offset of depth-(t+1) nodes in the subtree
+            for j in range(d):
+                node[j] |= ((c >> (t * d + j)) & 1) << t
+                for s in range(2):
+                    out[c, k] = j * 2 * T + s * T + base + node[j]
+                    k += 1
+    return out
+
+
+@partial(jax.jit, static_argnames=("d", "radix"))
+def _child_strings_radix_jit(packed: jax.Array, d: int, radix: int) -> jax.Array:
+    pos = jnp.asarray(_string_positions_radix(d, radix))  # [C, S']
+    return ((packed[:, None, :, None] >> pos[None, :, None, :]) & 1).astype(bool)
+
+
+def child_strings_radix(packed: jax.Array, d: int, radix: int) -> jax.Array:
+    """uint32[F, N] radix-packed share bits -> bool[F, 2^(radix*d), N,
+    2*d*radix] fused strings.  radix=1 delegates to ``child_strings`` so a
+    k=1 crawl hits the exact compiled program it always has."""
+    if radix == 1:
+        return child_strings(packed, d)
+    return _child_strings_radix_jit(packed, d, radix)
+
+
 # ---------------------------------------------------------------------------
 # Field payload codecs (OT payload width: FE62 one block, F255 two blocks)
 # ---------------------------------------------------------------------------
@@ -705,7 +747,7 @@ def _warm_pair():
 
 
 def warm_level_kernels(packed, d: int, field, path: str = "auto",
-                       share_sums=None) -> None:
+                       share_sums=None, radix: int = 1) -> None:
     """Run the WHOLE per-level 2PC kernel chain — string extraction,
     Δ-OT extension, the b2a share pair (both garbling signs), the
     whole-level equality message (1-of-2^S table or packed garbled
@@ -730,8 +772,14 @@ def warm_level_kernels(packed, d: int, field, path: str = "auto",
     ``share_sums`` overrides the share-sum reduction (the multi-chip
     server passes its ICI-psum form, ``ServerMesh.node_share_sums``, so
     the sharded reduction program is warmed too); None = the
-    single-device :func:`node_share_sums`."""
-    strs = child_strings(packed, d)
+    single-device :func:`node_share_sums`.
+
+    ``radix`` > 1 warms the fused radix-2^k shapes: the string stage
+    reads the radix packed layout and the equality chain runs at the
+    fused width S' = 2*d*radix (which may route through the GC ladder
+    where the radix-1 shape took ot2s — :func:`ot_path` decides from
+    S' exactly as the live crawl does)."""
+    strs = child_strings_radix(packed, d, radix)
     F_, C, N, S = strs.shape
     B = F_ * C * N
     flat = strs.reshape(B, S)
@@ -755,7 +803,7 @@ def warm_level_kernels(packed, d: int, field, path: str = "auto",
 
 
 def warm_level_kernels_sharded(ks, packed, d: int, F: int, N: int, field,
-                               path: str = "auto") -> None:
+                               path: str = "auto", radix: int = 1) -> None:
     """The :func:`warm_level_kernels` contract for a ROW-SHARDED kernel
     level (parallel/kernel_shard.py): compile the sharded flat builder,
     both roles of the row-sharded extension, the per-shard equality
@@ -769,17 +817,17 @@ def warm_level_kernels_sharded(ks, packed, d: int, F: int, N: int, field,
     mesh sharding (the client-axis expansion layout)."""
     from ..parallel import kernel_shard
 
-    flat = kernel_shard.shard_flat(ks, packed, d, F, N)
+    flat = kernel_shard.shard_flat(ks, packed, d, F, N, radix)
     snd, rcv = _warm_pair()
     zero = np.zeros(4, np.uint32)
     gseed, bseed = derive_seed(zero, 1, 0), derive_seed(zero, 2, 0)
-    p = ot_path(2 * d, path)
+    p = ot_path(2 * d * radix, path)
     vals_r = None
     for g in (0, 1):
         _, _, _, vals_r = kernel_shard.run_level_pair(
             ks, snd, rcv, flat, flat, gseed, bseed, field, g, p
         )
-    C = 1 << d
+    C = 1 << (d * radix)
     w = np.ones((F, C, N), bool)
     jax.block_until_ready(
         kernel_shard.share_sums(ks, field, vals_r, w, F, C, N)

@@ -286,6 +286,7 @@ _SESSION_GUARDS = {
     "_window_sketch_root": "_verb_lock",
     "_export_epoch": "_verb_lock",
     "_import_seen": "_verb_lock",
+    "_radix": "_verb_lock",
 }
 
 
@@ -323,6 +324,13 @@ class CollectionSession:
         # channel handshake (coin flip + base-OT) ran against; 0 = never
         self.plane_epoch = 0
         # -- crawl state ---------------------------------------------------
+        # radix-2^k level fusion (Config.crawl_radix_bits): bit levels
+        # fused per crawl verb.  Fixed per session at construction —
+        # leader and both servers derive it from the same config knob,
+        # and checkpoint/export blobs stamp it so a restore under a
+        # different radix refuses (validate-before-mutate)
+        collect.check_radix(cfg.n_dims, cfg.crawl_radix_bits)
+        self._radix: int = int(cfg.crawl_radix_bits)
         self.keys_parts: list = []
         self.keys: IbDcfKeyBatch | None = None
         self.alive_keys: np.ndarray | None = None
@@ -346,8 +354,10 @@ class CollectionSession:
         self._sketch_states: object | None = None
         self._sketch_pids: np.ndarray | None = None
         self._sketch_depth: int = 0
-        self._sketch_pairs: tuple | None = None
-        self._sketch_pairs_field: object | None = None
+        # stored value-pair shares awaiting the next sketch_verify: a
+        # list of (pairs, depth, field) — one entry per bit level of the
+        # latest fused prune (a single entry at crawl_radix_bits=1)
+        self._sketch_pairs: list | None = None
         self._sketch_seed: np.ndarray | None = None
         self._sketch_root: np.ndarray | None = None
         self._ratchet_digest: bytes | None = None
@@ -422,7 +432,6 @@ class CollectionSession:
         self._sketch_pids = None
         self._sketch_depth = 0
         self._sketch_pairs = None
-        self._sketch_pairs_field = None
         self._sketch_root = None
         self._ratchet_digest = None
         self._window_sketch_root = None
@@ -462,7 +471,6 @@ class CollectionSession:
         self._sketch_pids = None
         self._sketch_depth = 0
         self._sketch_pairs = None
-        self._sketch_pairs_field = None
         self._sketch_root = None
         self._ratchet_digest = None
         self._window_sketch_root = None
@@ -480,12 +488,22 @@ class CollectionSession:
 
     # -- engine/layout ----------------------------------------------------
 
-    def planar(self) -> bool:
+    def planar(self) -> bool:  # fhh-race: atomic (pure read of init-time state: _mesh/_radix are set at session construction)
         """This session's frontier LAYOUT: the process expand engine,
         except under the multi-chip mesh, which pins interleaved/XLA
         (the client axis must be a plain named axis — pallas_call takes
-        no sharded operands)."""
-        return collect._expand_engine() and self._mesh is None
+        no sharded operands), and under radix > 1 fusion, whose
+        multi-step expand is implemented on the interleaved/XLA engine
+        only (collect.expand_share_bits_radix)."""
+        return (collect._expand_engine() and self._mesh is None
+                and self._radix == 1)
+
+    def crawl_radix(self, level) -> int:  # fhh-race: atomic (pure read of init-time state)
+        """Fused bit count of the crawl round based at bit ``level``:
+        the session radix, clipped at the tail (data_len not divisible
+        by k leaves a final partial round of ``L - level`` bits)."""
+        L = self.keys.cw_seed.shape[-2]
+        return min(self._radix, L - int(level))
 
     def concat_keys(self) -> None:  # fhh-race: holds=_verb_lock (reached only from tree_init/tree_restore/warmup under this session's verb lock; sanitizer-validated)
         """Materialize ``self.keys`` from the uploaded chunks (shared by
@@ -542,42 +560,67 @@ class CollectionSession:
         counting an honest one-hot entry twice makes ``<r,x>² != <r²,x>``
         (with r_i + r_j in place of a single r).  Each dim keeps only the
         FIRST slot of every distinct prefix; the dedup table derives from
-        the public survivor table, so both servers gate identically."""
+        the public survivor table, so both servers gate identically.
+
+        Radix fusion: ``pat_bits`` may carry a step axis ([F, r, d] —
+        a fused prune at base bit level ``level``).  The sketch states
+        advance r eval_bit steps and EVERY step's value-pair shares are
+        stored (one ``_sketch_pairs`` entry per depth, each gated by its
+        own depth's dedup table), so the next batched ``sketch_verify``
+        opens every intermediate depth's Beaver slab exactly once and
+        keeps k=1's detection guarantee — a payload forged at a depth
+        the fused crawl never takes counts at is still caught, one
+        fused level later than a sequential crawl would catch it."""
         L = self.keys.cw_seed.shape[-2]
-        last = level == L - 1
-        fld = F255 if last else FE62
         k = self._sketch.key  # batch [N, d]
         d = k.root_seed.shape[1]
         # fhh-lint: disable=host-sync-in-hot-loop (wire input: host numpy)
         parent = np.asarray(parent)
+        pat_bits = np.asarray(pat_bits)
+        if pat_bits.ndim == 2:  # radix-1 callers: [F, d] -> [F, 1, d]
+            pat_bits = pat_bits[:, None, :]
+        r = pat_bits.shape[1]
         st = jax.tree.map(lambda a: a[parent], self._sketch_states)
-        direction = jnp.asarray(pat_bits, bool)[:, None, :]  # [F, 1, d]
-        cw = tuple(a[None] for a in dpf.level_cw(k, level))  # [1, N, d, ...]
-        cwv = (k.cw_val[..., level, :] if not last else k.cw_val_last)[None]
-        new_st, pair = dpf.eval_bit(
-            cw, st, direction, cwv, k.key_idx[None], fld, sketchmod.LANES
-        )  # pair [F, N, d, LANES(, limbs)]
         F2 = parent.shape[0]
-        pids = np.zeros((F2, d), np.int32)
-        keep = np.zeros((F2, d), bool)
         parent_pid = self._sketch_pids[parent[:n_alive]]  # [n_alive, d]
-        for j in range(d):
-            key_j = np.stack(
-                [parent_pid[:, j], pat_bits[:n_alive, j].astype(np.int32)], 1
+        pids = self._sketch_pids
+        entries = []
+        for t in range(r):
+            bl = level + t  # absolute bit level of this step
+            last = bl == L - 1
+            fld = F255 if last else FE62
+            direction = jnp.asarray(pat_bits[:, t, :], bool)[:, None, :]
+            cw = tuple(a[None] for a in dpf.level_cw(k, bl))  # [1, N, d, ...]
+            cwv = (k.cw_val[..., bl, :] if not last else k.cw_val_last)[None]
+            st, pair = dpf.eval_bit(
+                cw, st, direction, cwv, k.key_idx[None], fld, sketchmod.LANES
+            )  # pair [F, N, d, LANES(, limbs)]
+            # this depth's per-dim prefix dedup: key = parent pid chain +
+            # the step bits THROUGH step t (two fused survivors sharing a
+            # dim-j prefix at this depth are one node of dim j's 1-D
+            # sketch tree and must be counted once, even if they diverge
+            # at a later step)
+            pids = np.zeros((F2, d), np.int32)
+            keep = np.zeros((F2, d), bool)
+            for j in range(d):
+                key_j = np.stack(
+                    [parent_pid[:, j]]
+                    + [pat_bits[:n_alive, u, j].astype(np.int32)
+                       for u in range(t + 1)],
+                    1,
+                )
+                _, inv = np.unique(key_j, axis=0, return_inverse=True)
+                pids[:n_alive, j] = inv
+                _, first = np.unique(inv, return_index=True)
+                keep[first, j] = True
+            gate = jnp.asarray(
+                keep.reshape((F2, 1, d) + (1,) * (pair.ndim - 3))
             )
-            _, inv = np.unique(key_j, axis=0, return_inverse=True)
-            pids[:n_alive, j] = inv
-            _, first = np.unique(inv, return_index=True)
-            keep[first, j] = True
-        gate = jnp.asarray(
-            keep.reshape((F2, 1, d) + (1,) * (pair.ndim - 3))
-        )
-        pair = jnp.where(gate, pair, 0)
-        self._sketch_states = new_st
-        self._sketch_pids = pids
-        self._sketch_depth = level + 1
-        self._sketch_pairs = (pair, level + 1)
-        self._sketch_pairs_field = fld
+            entries.append((jnp.where(gate, pair, 0), bl + 1, fld))
+        self._sketch_states = st
+        self._sketch_pids = pids  # final depth's table seeds the next prune
+        self._sketch_depth = level + r
+        self._sketch_pairs = entries
 
     # -- crawl span bookkeeping -------------------------------------------
 

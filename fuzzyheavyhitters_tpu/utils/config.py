@@ -76,6 +76,18 @@ class Config:
     # (1 = sequential; >1 overlaps span k's plane I/O with span k+1's
     # device expand — protocol/leader_rpc.py pipelined crawl)
     crawl_pipeline_depth: int = 1
+    # radix-2^k level fusion (protocol/collect.py): crawl k prefix bits
+    # per wire round trip — each fused level expands every frontier node
+    # by all 2^(k·n_dims) child patterns, runs ONE equality stage at the
+    # fused string width S' = k·2·n_dims, and prunes on the depth-(base+k)
+    # counts (bit-identical to k sequential levels: a fused child
+    # survives iff its count clears threshold, and monotone counts make
+    # the intermediate-depth prunes subsumed).  1 = today's crawl,
+    # bit-identical compiled programs; 2 and 3 cut round trips, per-level
+    # telemetry, and checkpoint cadence by k.  Dim caps from the 32-bit
+    # packed plane (collect.check_radix): k=2 ⇒ n_dims ≤ 2, k=3 ⇒ 1.
+    # Both servers + leader must agree; checkpoints/exports stamp it.
+    crawl_radix_bits: int = 1
     # equality-test engine (protocol/secure.ot_path): "auto" runs the
     # 1-of-2^S chosen-payload OT (no garbled circuit) whenever the
     # string width S = 2·n_dims fits secure.OT2S_MAX_S, the garbled

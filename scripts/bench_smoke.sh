@@ -248,6 +248,17 @@ assert (skb.get("sketch_shards") or 0) >= 2, (
     "sharded sketch legs never engaged (sketch_shards < 2 — the "
     "row-sharded verify, parallel/sketch_shard.py): " + last[:300]
 )
+rx = doc.get("extra", {}).get("radix", {})
+assert rx.get("bit_identical"), (
+    "radix section (radix-2^k level fusion: k-sweep gated bit-identical "
+    "to k=1) missing from the compact line: " + last[:300]
+)
+assert rx.get("level_rate_x_k") is not None and (
+    rx.get("speedup_vs_k1") is not None
+), (
+    "radix headline keys (level_rate_x_k / speedup_vs_k1) missing from "
+    "the compact line: " + last[:300]
+)
 mt = doc.get("extra", {}).get("multitenant", {})
 assert mt.get("bit_identical_vs_solo"), (
     "multitenant section (per-collection sessions: bit-identity of "
@@ -273,6 +284,8 @@ print(
     f"(fill_ratio={mt['stall_fill_ratio']}), "
     f"sketch_overhead={skb['malicious_overhead_vs_semi_honest']} "
     f"(shards={skb['sketch_shards']}), "
+    f"radix_level_rate={rx['level_rate_x_k']} "
+    f"(speedup_vs_k1={rx['speedup_vs_k1']}), "
     f"slo_level_p95_ms={slo['level_p95_ms']}, "
     f"seal_to_hitters_p95_s={islo['seal_to_hitters_p95_s']}, "
     f"line={len(last)}B, elapsed={doc.get('budget', {}).get('elapsed_s')}s"

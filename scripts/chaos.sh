@@ -38,7 +38,7 @@ report="$(mktemp)"
 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_resilience.py tests/test_mesh_chaos.py tests/test_ingest.py \
     tests/test_multichip.py tests/test_sessions.py tests/test_sketch_shard.py \
-    tests/test_fleet.py \
+    tests/test_fleet.py tests/test_radix.py \
     -m "" -q \
     -p no:cacheprovider --junitxml="$report"
 rc=$?

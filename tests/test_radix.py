@@ -1,0 +1,585 @@
+"""Radix-2^k level fusion (``Config.crawl_radix_bits``): crawl k bits
+per round trip.
+
+Acceptance surface of the radix tentpole:
+
+- bit-identity: k in {2, 3} crawls produce the SAME heavy-hitter sets,
+  paths, and client liveness as k=1 — trusted, secure (ot2s AND the
+  S' > 6 GC ladder), and malicious/sketch lanes, single-device and
+  sharded-mesh, including tail levels (data_len % k != 0);
+- pruning equivalence: fused pruning at depths k, 2k, ... equals
+  sequential per-level pruning (count monotonicity makes intermediate
+  thresholds subsumed) — property-tested against an exact oracle;
+- round-trip accounting: a k=2 secure crawl issues ceil(L/2) crawl
+  verbs per server (vs L at k=1), observed through the per-session
+  ``rpc:{verb}`` histograms, and the leader's run report shrinks its
+  level count by the same factor;
+- warmup contract: a warmed k=2 crawl triggers ZERO fresh XLA
+  compiles (``compile_cache.backend_compiles`` fence);
+- cross-radix blobs refuse validate-before-mutate, BOTH directions:
+  driver checkpoints, server ``tree_checkpoint``/``tree_restore``
+  blobs, and ``session_export``/``session_import`` migration blobs
+  all stamp the radix.
+
+Shapes mirror tests/test_secure_kernels.py (L=5, d=1, f_max=8) so the
+k=1 baselines reuse programs those suites already compiled; the fused
+shapes are this suite's own compiles.
+"""
+
+import asyncio
+import math
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fuzzyheavyhitters_tpu.ops import ibdcf
+from fuzzyheavyhitters_tpu.ops.fields import F255, FE62
+from fuzzyheavyhitters_tpu.protocol import collect, driver, rpc, secure, sketch
+from fuzzyheavyhitters_tpu.protocol.leader_rpc import RpcLeader
+from fuzzyheavyhitters_tpu.utils import bits as bitutils, compile_cache
+from fuzzyheavyhitters_tpu.utils.config import Config
+
+BASE_PORT = 28131
+
+L, N = 5, 12
+
+
+@pytest.fixture(autouse=True)
+def _module_cpu(cpu_default):
+    """CPU backend: the fused shapes compile once and are shared across
+    every test in this module."""
+    yield
+
+
+def _cfg(port_base, **kw):
+    defaults = dict(
+        data_len=L,
+        n_dims=1,
+        ball_size=1,
+        addkey_batch_size=8,
+        num_sites=4,
+        threshold=0.2,
+        zipf_exponent=1.03,
+        server0=f"127.0.0.1:{port_base}",
+        server1=f"127.0.0.1:{port_base + 10}",
+        distribution="zipf",
+        f_max=8,
+        secure_exchange=True,
+    )
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+def _client_keys(rng, data_len=L, n=N, d=1):
+    pts = np.concatenate(
+        [np.full((n - 4, d), 11 % (1 << data_len)),
+         rng.integers(0, 1 << data_len, size=(4, d))]
+    )
+    pts_bits = np.array(
+        [[bitutils.int_to_bits(data_len, int(v)) for v in row] for row in pts]
+    )
+    return ibdcf.gen_l_inf_ball(pts_bits, 1, rng, engine="np")
+
+
+async def _run_crawl(cfg, port, k0, k1, sk0=None, sk1=None, nreqs=N,
+                     warmup=False):
+    s0 = rpc.CollectorServer(0, cfg)
+    s1 = rpc.CollectorServer(1, cfg)
+    t1 = asyncio.create_task(
+        s1.start("127.0.0.1", port + 10, "127.0.0.1", port + 11))
+    await asyncio.sleep(0.05)
+    t0 = asyncio.create_task(
+        s0.start("127.0.0.1", port, "127.0.0.1", port + 11))
+    await asyncio.gather(t0, t1)
+    c0 = await rpc.CollectorClient.connect("127.0.0.1", port)
+    c1 = await rpc.CollectorClient.connect("127.0.0.1", port + 10)
+    lead = RpcLeader(cfg, c0, c1)
+    await lead._both("reset")
+    await lead.upload_keys(k0, k1, sk0, sk1)
+    if warmup:
+        await lead.warmup()
+    res = await lead.run(nreqs)
+    out = {
+        "res": res,
+        "alive": None if s0.alive_keys is None else s0.alive_keys.copy(),
+        "lead_report": lead.obs.report(),
+        "server_reports": [s._default().obs.report() for s in (s0, s1)],
+    }
+    for c in (c0, c1):
+        await c.aclose()
+    for s in (s0, s1):
+        await s.aclose()
+    return out
+
+
+def _crawl(cfg, port, k0, k1, **kw):
+    return asyncio.run(_run_crawl(cfg, port, k0, k1, **kw))
+
+
+def _assert_parity(base, got, ctx):
+    np.testing.assert_array_equal(
+        base["res"].counts, got["res"].counts, err_msg=str(ctx))
+    np.testing.assert_array_equal(
+        base["res"].paths, got["res"].paths, err_msg=str(ctx))
+
+
+def _crawl_verbs(report):
+    hists = report["hists"]
+    return sum(
+        hists[v]["count"] for v in ("rpc:tree_crawl", "rpc:tree_crawl_last")
+        if v in hists
+    )
+
+
+# ---------------------------------------------------------------------------
+# host-side units: dim caps, survivor ordering, bit packing
+# ---------------------------------------------------------------------------
+
+
+def test_radix_dim_caps_and_pattern_order():
+    # packed per-(dim, side) layout holds 2^(r+1)-2 bits; 2*d*T <= 32
+    for d, r in ((8, 1), (2, 2), (1, 3)):
+        collect.check_radix(d, r)
+    for d, r in ((9, 1), (3, 2), (2, 3), (1, 4), (1, 0)):
+        with pytest.raises(ValueError):
+            collect.check_radix(d, r)
+    # S' = 2*d*r picks the kernel: ot2s through S' <= 6, GC past it —
+    # d=2 at k=2 is the first forced-GC shape (the slow-marked
+    # gc-route e2e crawls it; the routing decision stays in tier-1)
+    assert secure.ot_path(2 * 2 * 1, "auto") == "ot2s"
+    assert secure.ot_path(2 * 2 * 2, "auto") == "gc"
+
+    # r=1 visit order is the identity — the radix path degenerates to
+    # exactly the pre-radix survivor walk
+    for d in (1, 2, 3):
+        np.testing.assert_array_equal(
+            collect.radix_pattern_order(d, 1), np.arange(1 << d))
+
+    # fused ids are step-major (c = sum_t p_t * 2^(t*d)); the visit
+    # order ranks by the SEQUENTIAL tree walk (earlier steps most
+    # significant), so order[rank] must invert the rank formula
+    for d, r in ((1, 2), (1, 3), (2, 2)):
+        order = np.asarray(collect.radix_pattern_order(d, r))
+        assert sorted(order.tolist()) == list(range(1 << (d * r)))
+        for rank, c in enumerate(order.tolist()):
+            steps = [(c >> (t * d)) & ((1 << d) - 1) for t in range(r)]
+            want_rank = 0
+            for t, p in enumerate(steps):
+                want_rank += p << ((r - 1 - t) * d)
+            assert rank == want_rank, (d, r, c)
+
+    # pattern_to_bits_radix: [F, r, d] step bits reassemble the fused id
+    d, r = 2, 2
+    pat = np.arange(1 << (d * r), dtype=np.int32)
+    bits = collect.pattern_to_bits_radix(pat, d, r)
+    assert bits.shape == (pat.size, r, d)
+    shift = np.arange(r)[:, None] * d + np.arange(d)[None, :]
+    back = (bits.astype(np.int64) << shift).sum(axis=(1, 2))
+    np.testing.assert_array_equal(back, pat)
+
+
+def test_radix_fused_expand_matches_sequential():
+    """One fused r=2 expand == two chained r=1 expand/advance rounds:
+    same reconstructed counts for every fused child, and the fused
+    child-state cache advances to bit-identical frontier states."""
+    rng = np.random.default_rng(0)
+    Lx, n, d, r = 6, 24, 2, 2
+    pts = rng.integers(0, 1 << Lx, size=(n, d))
+    pts_bits = ((pts[..., None] >> np.arange(Lx - 1, -1, -1)) & 1) > 0
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 2, rng, engine="np")
+    k0 = jax.tree.map(jnp.asarray, ibdcf.IbDcfKeyBatch(*k0))
+    k1 = jax.tree.map(jnp.asarray, ibdcf.IbDcfKeyBatch(*k1))
+    alive_keys = jnp.ones(n, bool)
+
+    fr0 = collect.tree_init(k0, 1, planar=False)
+    fr1 = collect.tree_init(k1, 1, planar=False)
+    p0, ch0 = collect.expand_share_bits_radix(k0, fr0, 0, r, use_pallas=False)
+    p1, _ = collect.expand_share_bits_radix(k1, fr1, 0, r, use_pallas=False)
+    masks = jnp.asarray(collect.pattern_masks_radix(d, r))
+    fused = np.asarray(
+        collect.counts_by_pattern(p0, p1, masks, alive_keys, fr0.alive)
+    )  # [1, 2^(r*d)]
+
+    # sequential oracle: expand level 0, advance EVERY child, expand 1
+    _, c0 = collect.expand_share_bits(k0, fr0, 0, use_pallas=False)
+    _, c1 = collect.expand_share_bits(k1, fr1, 0, use_pallas=False)
+    C1 = 1 << d
+    parent = jnp.zeros(C1, jnp.int32)
+    pb = jnp.asarray(collect.pattern_to_bits(np.arange(C1, dtype=np.int32), d))
+    g0 = collect.advance_from_children(c0, parent, pb, C1)
+    g1 = collect.advance_from_children(c1, parent, pb, C1)
+    r0, cc0 = collect.expand_share_bits(k0, g0, 1, use_pallas=False)
+    r1, _ = collect.expand_share_bits(k1, g1, 1, use_pallas=False)
+    ref = np.asarray(collect.counts_by_pattern(
+        r0, r1, jnp.asarray(collect.pattern_masks(d)), alive_keys, g0.alive
+    ))  # [C1, C1]
+
+    # fused child c = a + (b << d): depth-1 node a, then its child b
+    for c in range(1 << (r * d)):
+        a, b = c & (C1 - 1), (c >> d) & (C1 - 1)
+        assert fused[0, c] == ref[a, b], (c, a, b)
+
+    # fused advance over the banked child cache == two r=1 advances
+    keep = np.zeros((1, 1 << (r * d)), bool)
+    keep[0, :] = fused[0] >= 1
+    par, pat, na = collect.compact_survivors(keep, 64)
+    pbits = collect.pattern_to_bits_radix(pat, d, r)
+    fr_fused = collect.advance_from_children_radix(
+        ch0, jnp.asarray(par), jnp.asarray(pbits), na, r)
+    a_all = pat & (C1 - 1)
+    b_all = (pat >> d) & (C1 - 1)
+    h0 = collect.advance_from_children(
+        c0, jnp.zeros(par.shape[0], jnp.int32),
+        jnp.asarray(collect.pattern_to_bits(a_all, d)), na)
+    _, hc0 = collect.expand_share_bits(k0, h0, 1, use_pallas=False)
+    fr_seq = collect.advance_from_children(
+        hc0, jnp.arange(par.shape[0]),
+        jnp.asarray(collect.pattern_to_bits(b_all, d)), na)
+    for x, y in zip(fr_fused.states, fr_seq.states):
+        np.testing.assert_array_equal(
+            np.asarray(x)[:na], np.asarray(y)[:na])
+
+
+def test_radix_prune_equivalence_property():
+    """Fused pruning visits only depths k, 2k, ... — yet keeps exactly
+    the prefixes sequential per-level pruning keeps.  The invariant that
+    makes this an equivalence, not an approximation: prefix counts are
+    monotone (count(p) >= count(p + suffix)), so a depth-t survivor's
+    every ancestor also clears the threshold and the skipped
+    intermediate prunes are subsumed.  Property-checked against an
+    exact-oracle recursion over random datasets."""
+    rng = np.random.default_rng(42)
+
+    def survivors(pts, grid, thresh):
+        """Exact crawl over the named depth grid: count each frontier
+        node's depth-t extensions, keep those clearing the threshold."""
+        frontier = {()}
+        out = {}
+        prev = 0
+        for depth in grid:
+            counts = {}
+            for v in pts:
+                p = v[:depth]
+                if p[:prev] in frontier:
+                    counts[p] = counts.get(p, 0) + 1
+            frontier = {p for p, c in counts.items() if c >= thresh}
+            out[depth] = frontier
+            prev = depth
+        return out
+
+    for trial in range(25):
+        Lx = 6
+        k = int(rng.integers(2, 4))
+        n = int(rng.integers(15, 50))
+        thresh = int(rng.integers(1, 5))
+        # cluster: heavy values + noise, as strings of bits
+        vals = rng.integers(0, 1 << Lx, size=n)
+        vals[: n // 2] = vals[0]
+        pts = [tuple(bool((v >> (Lx - 1 - t)) & 1) for t in range(Lx))
+               for v in vals]
+
+        seq = survivors(pts, list(range(1, Lx + 1)), thresh)
+        fused_grid = [min(b + k, Lx) for b in range(0, Lx, k)]
+        fused = survivors(pts, fused_grid, thresh)
+        for depth in fused_grid:
+            assert fused[depth] == seq[depth], (trial, k, depth)
+
+
+# ---------------------------------------------------------------------------
+# in-process driver: parity incl. tail levels, cross-radix checkpoints
+# ---------------------------------------------------------------------------
+
+
+def _driver_keys(Lx, n, d, seed=0):
+    rng = np.random.default_rng(seed)
+    pts = rng.integers(0, 1 << Lx, size=(n, d))
+    pts[: n // 3] = pts[0]
+    pts[n // 3: n // 2] = pts[n // 3]
+    bits = ((pts[..., None] >> np.arange(Lx - 1, -1, -1)) & 1) > 0
+    k0, k1 = ibdcf.gen_l_inf_ball(bits, 2, rng, engine="np")
+    k0 = jax.tree.map(jnp.asarray, ibdcf.IbDcfKeyBatch(*k0))
+    k1 = jax.tree.map(jnp.asarray, ibdcf.IbDcfKeyBatch(*k1))
+    return k0, k1
+
+
+def _driver_crawl(k0, k1, Lx, d, radix, n=40):
+    s0, s1 = driver.make_servers(k0, k1)
+    lead = driver.Leader(
+        s0, s1, n_dims=d, data_len=Lx, f_max=128, radix=radix)
+    return lead.run(n, 0.1)
+
+
+def test_radix_driver_parity_and_tail_levels():
+    """Trusted in-process crawls, k in {2, 3} vs k=1 — both (L, d)
+    scenarios leave a tail level (data_len % k != 0), so the final
+    round fuses r = L mod k < k bits and must stay bit-exact."""
+    for Lx, d, ks in ((7, 1, (2, 3)), (5, 2, (2,))):
+        k0, k1 = _driver_keys(Lx, 40, d)
+        base = _driver_crawl(k0, k1, Lx, d, 1)
+        assert base.paths.shape[0] >= 1
+        for k in ks:
+            assert Lx % k != 0  # the scenario really exercises the tail
+            got = _driver_crawl(k0, k1, Lx, d, k)
+            np.testing.assert_array_equal(base.paths, got.paths)
+            np.testing.assert_array_equal(base.counts, got.counts)
+
+
+def test_radix_driver_checkpoint_refuses_cross_radix(tmp_path):
+    """Driver checkpoints stamp the crawl radix; a k=2 blob refuses a
+    k=1 leader and vice versa (validate-before-mutate), while a
+    same-radix resume completes bit-identically."""
+    Lx, d, n, thr = 6, 1, 40, 0.1
+    k0, k1 = _driver_keys(Lx, n, d, seed=3)
+    base = _driver_crawl(k0, k1, Lx, d, 2, n=n)
+
+    ck2 = str(tmp_path / "k2.npz")
+    s0a, s1a = driver.make_servers(k0, k1)
+    lead_a = driver.Leader(s0a, s1a, n_dims=d, data_len=Lx, f_max=128,
+                           radix=2)
+    lead_a.tree_init()
+    assert lead_a.run_level(0, nreqs=n, threshold=thr) > 0  # bits 0..1
+    lead_a.checkpoint(ck2, 0)
+
+    # k=2 blob -> k=1 leader: refused, live state untouched
+    s0b, s1b = driver.make_servers(k0, k1)
+    lead_1 = driver.Leader(s0b, s1b, n_dims=d, data_len=Lx, f_max=128)
+    with pytest.raises(ValueError, match="crawl radix 2"):
+        lead_1.restore(ck2)
+    assert lead_1.paths is None and s0b.frontier is None
+
+    # k=1 blob -> k=2 leader: refused too (the other direction)
+    ck1 = str(tmp_path / "k1.npz")
+    lead_1.tree_init()
+    lead_1.run_level(0, nreqs=n, threshold=thr)
+    lead_1.checkpoint(ck1, 0)
+    s0c, s1c = driver.make_servers(k0, k1)
+    lead_b = driver.Leader(s0c, s1c, n_dims=d, data_len=Lx, f_max=128,
+                           radix=2)
+    with pytest.raises(ValueError, match="crawl radix 1"):
+        lead_b.restore(ck1)
+    assert lead_b.paths is None and s0c.frontier is None
+
+    # positive control: the k=2 blob resumes a fresh k=2 leader to the
+    # exact uninterrupted result (restore returns base + r = 2)
+    s0d, s1d = driver.make_servers(k0, k1)
+    lead_c = driver.Leader(s0d, s1d, n_dims=d, data_len=Lx, f_max=128,
+                           radix=2)
+    got = lead_c.run(nreqs=n, threshold=thr, checkpoint_path=ck2,
+                     resume=True)
+    np.testing.assert_array_equal(base.paths, got.paths)
+    np.testing.assert_array_equal(base.counts, got.counts)
+
+
+# ---------------------------------------------------------------------------
+# RPC end-to-end: secure parity + round-trip accounting, GC route,
+# malicious lane, sharded mesh, warm-compile contract
+# ---------------------------------------------------------------------------
+
+
+def test_radix_secure_parity_and_round_trip_count():
+    """Secure (ot2s, S' = 2k <= 6) crawls at k in {2, 3} are
+    bit-identical to k=1 and issue exactly ceil(L/k) crawl verbs per
+    server — the fused round trips the tentpole buys, asserted through
+    the per-session ``rpc:{verb}`` histograms and the leader's
+    level-latency report (L=5: tails for both k)."""
+    rng = np.random.default_rng(7)
+    k0, k1 = _client_keys(rng)
+    port = BASE_PORT
+    base = _crawl(_cfg(port), port, k0, k1)
+    assert base["res"].paths.shape[0] >= 1
+    assert base["lead_report"]["hists"]["level_latency"]["count"] == L
+    for s_rep in base["server_reports"]:
+        assert _crawl_verbs(s_rep) == L
+    port += 40
+    for k in (2, 3):
+        got = _crawl(_cfg(port, crawl_radix_bits=k), port, k0, k1)
+        port += 40
+        _assert_parity(base, got, {"k": k})
+        levels = math.ceil(L / k)
+        # run report shrinks its level count by k
+        assert got["lead_report"]["hists"]["level_latency"]["count"] == levels
+        # structural round-trip bound from the issue: <= ceil(L/k) + 1
+        # crawl verbs per server — and in fact exactly ceil(L/k)
+        for s_rep in got["server_reports"]:
+            assert _crawl_verbs(s_rep) == levels
+
+
+# The three heaviest radix e2e lanes below (GC route, malicious,
+# sharded mesh — full socket crawls at distinct compile shapes) are
+# @pytest.mark.slow so tier-1 stays inside its 870 s wall clock on one
+# core; scripts/chaos.sh runs tests/test_radix.py with `-m ""` so they
+# execute on every chaos/CI pass (the PR-19 pattern).  The cheap tier-1
+# lanes above them keep every fused program shape covered: secure
+# parity + verb counts (ot2s), warmed-zero-compiles, driver tail
+# levels, and the pruning property.
+
+
+@pytest.mark.slow
+def test_radix_gc_route_parity():
+    """d=2 at k=2 gives S' = 2*d*k = 8 > OT2S ceiling: the fused level
+    must route through the GC ladder and still match k=1 (which runs
+    ot2s at S=4) bit-for-bit."""
+    assert secure.ot_path(2 * 2 * 1, "auto") == "ot2s"
+    assert secure.ot_path(2 * 2 * 2, "auto") == "gc"
+    rng = np.random.default_rng(9)
+    Lx, d = 4, 2
+    k0, k1 = _client_keys(rng, data_len=Lx, d=d)
+    port = BASE_PORT + 200
+    base = _crawl(_cfg(port, data_len=Lx, n_dims=d, f_max=32), port, k0, k1)
+    assert base["res"].counts.size
+    port += 40
+    got = _crawl(
+        _cfg(port, data_len=Lx, n_dims=d, f_max=32, crawl_radix_bits=2),
+        port, k0, k1)
+    _assert_parity(base, got, "gc-route")
+
+
+def _sketch_material(rng):
+    """Malicious-lane client material with client 3's sketch payload
+    forged at bit level 2 (mirrors tests/test_sketch_shard.py): an
+    honest run must exclude exactly that client."""
+    pts = np.array([[11]] * 8 + [[25], [2], [50], [60]])
+    pts_bits = np.array(
+        [[bitutils.int_to_bits(L, int(v)) for v in row] for row in pts]
+    )
+    k0, k1 = ibdcf.gen_l_inf_ball(pts_bits, 1, rng, engine="np")
+    seeds = rng.integers(0, 2**32, size=(N, 2, 4), dtype=np.uint32)
+    cseed = rng.integers(0, 2**32, size=4, dtype=np.uint32)
+    sk0, sk1 = sketch.gen(seeds, pts_bits[:, 0, :], FE62, F255, cseed)
+    bad = np.asarray(sk0.key.cw_val).copy()
+    bad[3, 0, 2, 0] = (int(bad[3, 0, 2, 0]) + 1) % FE62.P
+    j = jnp.asarray(bad)
+    sk0 = sk0._replace(key=sk0.key._replace(cw_val=j))
+    sk1 = sk1._replace(key=sk1.key._replace(cw_val=j))
+    return k0, k1, sk0, sk1
+
+
+@pytest.mark.slow
+def test_radix_malicious_parity_excludes_forged_payload():
+    """Sketch lane under fusion: the fused prune banks one gated pair
+    share per fused BIT level and the final verify opens each under its
+    own ratcheted challenge — so a payload forged at an intermediate
+    depth is still caught, the cheater's keys go dead, and counts,
+    paths, and liveness all match k=1 exactly."""
+    rng = np.random.default_rng(11)
+    k0, k1, sk0, sk1 = _sketch_material(rng)
+    kw = dict(f_max=32, malicious=True, threshold=0.5)
+    port = BASE_PORT + 400
+    base = _crawl(_cfg(port, **kw), port, k0, k1, sk0=sk0, sk1=sk1)
+    want_alive = np.ones(N, bool)
+    want_alive[3] = False
+    np.testing.assert_array_equal(base["alive"], want_alive)
+    port += 40
+    for k in (2, 3):
+        got = _crawl(_cfg(port, crawl_radix_bits=k, **kw), port, k0, k1,
+                     sk0=sk0, sk1=sk1)
+        port += 40
+        np.testing.assert_array_equal(got["alive"], want_alive)
+        _assert_parity(base, got, {"malicious-k": k})
+
+
+@pytest.mark.slow
+def test_radix_mesh_parity():
+    """Sharded mesh lane (server_data_devices=4 on the 8-device CPU
+    mesh): fused crawls match k=1 under both exchanges — the sharded
+    kernel plan binds the widened S' = 2k strings per shard."""
+    rng = np.random.default_rng(77)
+    k0, k1 = _client_keys(rng)
+    port = BASE_PORT + 600
+    for mode_kw in (dict(secure_exchange=True), dict(secure_exchange=False)):
+        base = _crawl(
+            _cfg(port, server_data_devices=4, **mode_kw), port, k0, k1)
+        port += 40
+        got = _crawl(
+            _cfg(port, server_data_devices=4, crawl_radix_bits=2, **mode_kw),
+            port, k0, k1)
+        port += 40
+        _assert_parity(base, got, mode_kw)
+
+
+def test_radix_warmed_crawl_zero_fresh_compiles():
+    """The warmup ladder covers the fused shapes: a second, fully-warmed
+    k=2 secure crawl triggers ZERO fresh XLA compiles (the
+    ``backend_compiles`` fence the ISSUE names)."""
+    rng = np.random.default_rng(5)
+    k0, k1 = _client_keys(rng)
+    port = BASE_PORT + 800
+    kw = dict(crawl_radix_bits=2, secure_exchange=True)
+    first = _crawl(_cfg(port, **kw), port, k0, k1, warmup=True)
+    before = compile_cache.backend_compiles()
+    second = _crawl(_cfg(port + 40, **kw), port + 40, k0, k1, warmup=True)
+    fresh = compile_cache.backend_compiles() - before
+    _assert_parity(first, second, "warmed")
+    assert fresh == 0, f"{fresh} fresh compiles in a fully-warmed k=2 crawl"
+
+
+# ---------------------------------------------------------------------------
+# cross-radix blob refusals: tree_restore + session_import (both ways)
+# ---------------------------------------------------------------------------
+
+
+def _chunk(k, sl):
+    return tuple(np.asarray(x)[sl] for x in k)
+
+
+def test_radix_tree_restore_and_session_import_refuse_cross_radix(tmp_path):
+    """Server-side blobs stamp the radix too: ``tree_restore`` and
+    ``session_import`` refuse a blob written under the other radix — in
+    BOTH directions — with live state untouched, and a same-radix
+    restore still lands."""
+    port = BASE_PORT + 1000
+    k0, _ = _client_keys(np.random.default_rng(13))
+    dir2, dir1 = str(tmp_path / "k2"), str(tmp_path / "k1")
+    os.makedirs(dir2)
+    os.makedirs(dir1)
+
+    async def run():
+        sub = {"window": 0, "sub_id": "a", "client_id": "c",
+               "keys": _chunk(k0, slice(0, 2))}
+        src2 = rpc.CollectorServer(
+            0, _cfg(port, crawl_radix_bits=2), ckpt_dir=dir2)
+        await src2.submit_keys(sub)
+        await src2.tree_checkpoint({"level": 0, "ingest_only": True})
+        x2 = await src2.session_export({})
+        src1 = rpc.CollectorServer(0, _cfg(port), ckpt_dir=dir1)
+        await src1.submit_keys(sub)
+        await src1.tree_checkpoint({"level": 0, "ingest_only": True})
+        x1 = await src1.session_export({})
+
+        # k=2 blob -> k=1 session (and the reverse): refused untouched
+        dst1 = rpc.CollectorServer(0, _cfg(port), ckpt_dir=dir2)
+        with pytest.raises(RuntimeError, match="crawl_radix_bits=2"):
+            await dst1.tree_restore({"level": 0})
+        with pytest.raises(RuntimeError, match="crawl_radix_bits=2"):
+            await dst1.session_import(
+                {"path": x2["path"], "boot": x2["boot"],
+                 "epoch": x2["epoch"]})
+        assert dst1._default()._ingest_pools == {}
+        assert dst1._default().frontier is None
+
+        dst2 = rpc.CollectorServer(
+            0, _cfg(port, crawl_radix_bits=2), ckpt_dir=dir1)
+        with pytest.raises(RuntimeError, match="crawl_radix_bits=1"):
+            await dst2.tree_restore({"level": 0})
+        with pytest.raises(RuntimeError, match="crawl_radix_bits=1"):
+            await dst2.session_import(
+                {"path": x1["path"], "boot": x1["boot"],
+                 "epoch": x1["epoch"]})
+        assert dst2._default()._ingest_pools == {}
+
+        # positive control: the SAME radix restores/imports fine
+        ok = rpc.CollectorServer(
+            0, _cfg(port, crawl_radix_bits=2), ckpt_dir=dir2)
+        await ok.tree_restore({"level": 0})
+        assert len(ok._default()._ingest_pools[0].entries) == 1
+        ok2 = rpc.CollectorServer(
+            0, _cfg(port, crawl_radix_bits=2), ckpt_dir=dir2)
+        await ok2.session_import(
+            {"path": x2["path"], "boot": x2["boot"], "epoch": x2["epoch"]})
+        assert len(ok2._default()._ingest_pools[0].entries) == 1
+
+    asyncio.run(run())
